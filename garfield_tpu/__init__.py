@@ -1,0 +1,39 @@
+"""garfield_tpu — a TPU-native framework for Byzantine-resilient distributed SGD.
+
+A ground-up re-design, for TPU hardware, of the capabilities of EPFL DCL's
+Garfield library (reference: /root/reference — "Garfield: System Support for
+Byzantine Machine Learning", arXiv:2010.05888).
+
+Where the reference builds Byzantine resilience out of multi-process RPC
+(torch.distributed.rpc / gRPC) between parameter servers and workers, this
+framework expresses the whole worker/server topology as a single SPMD program
+over a `jax.sharding.Mesh`:
+
+    grads = per-worker gradients           (shard_map over mesh axis "workers")
+    grads = attack(grads, byz_mask, key)   (on-device fault injection)
+    stack = all_gather(grads, "workers")   (ICI collective — replaces RPC)
+    update = gar(stack, f)                 (robust aggregation, jit'd XLA)
+    state = optimizer(state, update)       (replicated => "write_model" is free)
+
+Subpackages
+-----------
+aggregators : robust Gradient Aggregation Rules (GARs) — the L1 of the
+              reference (pytorch_impl/libs/aggregators/).
+attacks     : Byzantine gradient/model attack simulators — reference
+              byzWorker.py / byzServer.py / attacker.py.
+data        : deterministic dataset partitioning — reference datasets.py.
+models      : flax model zoo — reference garfieldpp/models/.
+parallel    : meshes, SPMD train steps, topologies (SSMW/MSMW/LEARN/CC) —
+              reference applications' trainer loops + Garfield_CC.
+roles       : Worker/Server/ByzWorker/ByzServer role objects (API parity).
+native      : C++ CPU kernels + threadpool (reference libs/native/).
+utils       : logging, registries, optimizer/loss selectors — reference
+              garfieldpp/tools.py and libs/tools/.
+"""
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "aggregators",
+    "utils",
+]

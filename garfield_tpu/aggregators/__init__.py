@@ -1,0 +1,76 @@
+"""Robust Gradient Aggregation Rule (GAR) registry.
+
+TPU-native counterpart of pytorch_impl/libs/aggregators/__init__.py:
+  - ``make_gar`` (:42-69) wraps each rule with a checked variant selected
+    under ``__debug__``;
+  - ``register`` (:71-86) lets each rule module self-register;
+  - ``gars`` (:89) is the name -> rule mapping;
+  - sibling rule modules are auto-imported (:91-97).
+
+Every rule is a pure function of a stacked ``(n, d)`` gradient array (or a
+reference-style list of 1-D vectors) and tolerance ``f``; rules are
+jit-compatible with static ``n`` and ``f`` and run as XLA on TPU. The
+``native-*`` variants (C++ CPU kernels via the garfield_tpu.native runtime,
+mirroring the reference's pytorch_impl/libs/native/) register themselves when
+the native toolchain is available.
+"""
+
+import importlib
+import pkgutil
+
+from ..utils import tools
+
+__all__ = ["gars", "register", "GAR"]
+
+
+class GAR:
+    """A registered aggregation rule.
+
+    Attributes mirror the reference wrapper (aggregators/__init__.py:63-67):
+    ``unchecked`` (raw rule), ``checked`` (validates with ``check`` first),
+    ``check``, ``upper_bound``, ``influence``. Calling the GAR dispatches to
+    ``checked`` when ``__debug__`` else ``unchecked`` (:61).
+    """
+
+    def __init__(self, name, unchecked, check, upper_bound=None, influence=None):
+        self.name = name
+        self.unchecked = unchecked
+        self.check = check
+
+        def checked(gradients, *args, **kwargs):
+            message = check(gradients, *args, **kwargs)
+            if message is not None:
+                raise AssertionError(
+                    f"aggregation rule {name!r} cannot be used: {message}"
+                )
+            return unchecked(gradients, *args, **kwargs)
+
+        self.checked = checked
+        self.upper_bound = upper_bound
+        self.influence = influence
+        self._call = checked if __debug__ else unchecked
+
+    def __call__(self, gradients, *args, **kwargs):
+        return self._call(gradients, *args, **kwargs)
+
+    def __repr__(self):
+        return f"<GAR {self.name}>"
+
+
+gars = {}
+
+
+def register(name, unchecked, check, upper_bound=None, influence=None):
+    """Register an aggregation rule (reference __init__.py:71-86)."""
+    if name in gars:
+        tools.warning(f"GAR {name!r} already registered; overwriting")
+    gar = GAR(name, unchecked, check, upper_bound=upper_bound, influence=influence)
+    gars[name] = gar
+    return gar
+
+
+# Auto-import sibling rule modules so each self-registers (reference :91-97).
+for _modinfo in pkgutil.iter_modules(__path__):
+    if _modinfo.name.startswith("_"):
+        continue
+    importlib.import_module(f"{__name__}.{_modinfo.name}")

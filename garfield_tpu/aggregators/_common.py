@@ -1,0 +1,74 @@
+"""Shared jit-friendly primitives for the GAR library.
+
+These helpers encode the semantics that every reference rule builds on
+(pytorch_impl/libs/aggregators/*.py):
+  - pairwise Euclidean (non-squared) distances with non-finite values mapped
+    to +inf (krum.py:44-48, bulyan.py, brute.py:33-36);
+  - the *lower* coordinate-wise median — torch's ``median(dim=0)`` returns the
+    lower of the two middle elements for even n, and sorts NaN last, which is
+    what makes the reference's median "NaN-resilient" (median.py:39).
+
+"Sum of the k smallest" selections (krum.py:55-63) appear rule-side as sorted
+prefix sums; stable ``jnp.argsort`` reproduces the reference's stable
+``list.sort`` tie-breaking.
+
+All functions are pure and shape-polymorphic only in the static sense: n, d,
+f must be Python ints at trace time (XLA static shapes).
+"""
+
+import jax.numpy as jnp
+
+
+def as_stack(gradients):
+    """Normalize input to a (n, d) stacked array.
+
+    Accepts the reference-style list of 1-D vectors (krum.py aggregate takes
+    ``gradients`` as a list) or an already-stacked 2-D array — the natural
+    form after ``jax.lax.all_gather`` on the workers mesh axis.
+    """
+    if isinstance(gradients, (list, tuple)):
+        return jnp.stack([jnp.asarray(g).reshape(-1) for g in gradients])
+    g = jnp.asarray(gradients)
+    if g.ndim != 2:
+        raise ValueError(f"expected (n, d) gradient stack, got shape {g.shape}")
+    return g
+
+
+def num_gradients(gradients):
+    """Static number of gradients n (leading dim / list length)."""
+    if isinstance(gradients, (list, tuple)):
+        return len(gradients)
+    return int(gradients.shape[0])
+
+
+def pairwise_distances(g, *, exclude_self=True):
+    """(n, n) Euclidean distance matrix via the Gram trick.
+
+    Uses ||x-y||^2 = ||x||^2 + ||y||^2 - 2<x,y> so the inner product rides
+    the MXU instead of materializing (n, n, d) differences. Non-finite
+    distances (a Byzantine gradient containing NaN/Inf poisons its whole row)
+    become +inf, mirroring the reference's isfinite guard (krum.py:46-48).
+    The diagonal is +inf when exclude_self (so "k smallest" never counts the
+    self-distance), else 0.
+    """
+    sq = jnp.sum(g * g, axis=-1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (g @ g.T)
+    dist = jnp.sqrt(jnp.maximum(d2, 0.0))
+    dist = jnp.where(jnp.isfinite(dist), dist, jnp.inf)
+    n = g.shape[0]
+    diag = jnp.inf if exclude_self else 0.0
+    return jnp.where(jnp.eye(n, dtype=bool), diag, dist)
+
+
+def coordinate_median(g):
+    """Lower coordinate-wise median of a (n, d) stack -> (d,).
+
+    torch's ``stack(g).median(dim=0)[0]`` semantics (median.py:39): for even n
+    the smaller middle element (index (n-1)//2 of the sorted column), and NaN
+    values sort last so up to ceil(n/2)-1 NaN entries per coordinate do not
+    contaminate the result.
+    """
+    n = g.shape[0]
+    return jnp.sort(g, axis=0)[(n - 1) // 2]
+
+

@@ -1,0 +1,58 @@
+"""Aksel GAR: average of the gradients closest to the coordinate-wise median.
+
+Counterpart of pytorch_impl/libs/aggregators/aksel.py (:24-64): compute the
+coordinate-wise median, rank gradients by squared Euclidean distance to it,
+and average the c closest, where c = (n+1)//2 in mode "mid" or c = n-f in
+mode "n-f". Requires n >= 2f+1.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import register
+from ._common import as_stack, coordinate_median, num_gradients
+
+
+def _selection(g, f, mode):
+    n = g.shape[0]
+    med = coordinate_median(g)
+    dist = jnp.sum((g - med[None, :]) ** 2, axis=1)
+    if mode == "mid":
+        c = (n + 1) // 2
+    elif mode == "n-f":
+        c = n - f
+    else:
+        raise NotImplementedError(f"unknown aksel mode {mode!r}")
+    return jnp.argsort(dist)[:c], c
+
+
+def aggregate(gradients, f, mode="mid", **kwargs):
+    """Average of the c gradients closest to the coordinate median."""
+    g = as_stack(gradients)
+    sel, _ = _selection(g, f, mode)
+    return jnp.mean(g[sel], axis=0)
+
+
+def check(gradients, f, mode="mid", **kwargs):
+    n = num_gradients(gradients)
+    if n < 1:
+        return f"expected at least one gradient to aggregate, got {gradients!r}"
+    if not isinstance(f, int) or f < 1 or n < 2 * f + 1:
+        return (
+            f"invalid number of Byzantine gradients to tolerate, got f = {f!r}, "
+            f"expected 1 <= f <= {(n - 1) // 2}"
+        )
+    if mode not in ("mid", "n-f"):
+        return f"invalid operation mode {mode!r}"
+    return None
+
+
+def influence(honests, attacks, f, mode="mid", **kwargs):
+    """Ratio of Byzantine gradients among the c selected (aksel.py:76-98)."""
+    stack = jnp.concatenate([as_stack(honests), as_stack(attacks)], axis=0)
+    sel, c = _selection(stack, f, mode)
+    sel = np.asarray(sel)
+    return float(np.sum(sel >= len(honests))) / c
+
+
+register("aksel", aggregate, check, influence=influence)

@@ -1,0 +1,29 @@
+"""Averaging GAR (non-robust baseline).
+
+Counterpart of pytorch_impl/libs/aggregators/average.py (:21-29 aggregate,
+influence = accepted fraction).
+"""
+
+import jax.numpy as jnp
+
+from . import register
+from ._common import as_stack, num_gradients
+
+
+def aggregate(gradients, **kwargs):
+    """Arithmetic mean of the gradients."""
+    return jnp.mean(as_stack(gradients), axis=0)
+
+
+def check(gradients, **kwargs):
+    if num_gradients(gradients) < 1:
+        return f"expected at least one gradient to aggregate, got {gradients!r}"
+    return None
+
+
+def influence(honests, attacks, **kwargs):
+    """Every gradient is accepted: ratio = |attacks| / n (average.py:29-37)."""
+    return len(attacks) / (len(honests) + len(attacks))
+
+
+register("average", aggregate, check, influence=influence)

@@ -1,0 +1,54 @@
+"""Condense GAR: randomized coordinate mixing of median and first gradient.
+
+Counterpart of pytorch_impl/libs/aggregators/condense.py (:36-42): sample a
+Bernoulli(p) mask per coordinate; output = mask * median + (1-mask) * g[0].
+Requires n >= 2f+2 (:56).
+
+Randomness: jax is functionally pure, so the rule takes an explicit PRNG
+``key``. When omitted (host-side convenience, matching the reference's use of
+the torch global RNG), a module-level counter-derived key is used — calls
+remain deterministic per process but vary per call. Inside jit, pass ``key``.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import register
+from ._common import as_stack, coordinate_median, num_gradients
+
+_fallback_count = 0
+
+
+def aggregate(gradients, f, p=0.9, key=None, **kwargs):
+    """Bernoulli(p)-masked mix of coordinate median and gradient 0."""
+    g = as_stack(gradients)
+    if key is None:
+        global _fallback_count
+        key = jax.random.key(_fallback_count)
+        _fallback_count += 1
+    mask = jax.random.bernoulli(key, p, shape=(g.shape[1],)).astype(g.dtype)
+    return coordinate_median(g) * mask + g[0] * (1.0 - mask)
+
+
+def check(gradients, f, p=0.9, key=None, **kwargs):
+    n = num_gradients(gradients)
+    if n < 1:
+        return f"expected at least one gradient to aggregate, got {gradients!r}"
+    if not isinstance(f, int) or f < 1 or n < 2 * f + 2:
+        return (
+            f"invalid number of Byzantine gradients to tolerate, got f = {f!r}, "
+            f"expected 1 <= f <= {(n - 2) // 2}"
+        )
+    if p <= 0 or p > 1:
+        return f"expected positive selection probability, got {p}"
+    return None
+
+
+def upper_bound(n, f, d):
+    """Same bound as the median, 1/sqrt(n-f) (condense.py:60-69)."""
+    return 1 / math.sqrt(n - f)
+
+
+register("condense", aggregate, check, upper_bound=upper_bound)

@@ -1,0 +1,33 @@
+"""NaN-resilient coordinate-wise median GAR.
+
+Counterpart of pytorch_impl/libs/aggregators/median.py (aggregate :39 =
+``torch.stack(g).median(dim=0)[0]``, upper_bound 1/sqrt(n-f) :62-71). The
+lower-median + NaN-sorts-last semantics are preserved (see
+_common.coordinate_median). Sort-based median is the right TPU form: one
+XLA sort along the small axis, no host round-trip (reference needed a CUDA
+kernel, median.cu).
+"""
+
+import math
+
+from . import register
+from ._common import as_stack, coordinate_median, num_gradients
+
+
+def aggregate(gradients, **kwargs):
+    """NaN-resilient coordinate-wise (lower) median."""
+    return coordinate_median(as_stack(gradients))
+
+
+def check(gradients, **kwargs):
+    if num_gradients(gradients) < 1:
+        return f"expected at least one gradient to aggregate, got {gradients!r}"
+    return None
+
+
+def upper_bound(n, f, d):
+    """Variance/norm ratio bound 1/sqrt(n-f) (median.py:62-71)."""
+    return 1 / math.sqrt(n - f)
+
+
+register("median", aggregate, check, upper_bound=upper_bound)

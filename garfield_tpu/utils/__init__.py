@@ -1,0 +1,23 @@
+"""Shared infrastructure: logging, registries, selectors, timing.
+
+TPU-native counterpart of the reference's two tool packages:
+  - pytorch_impl/libs/tools/ (colored Context logging :34-122, ClassRegister
+    misc.py:118-172, pairwise misc.py:518-530, timing misc.py:533-568)
+  - pytorch_impl/libs/garfieldpp/tools.py (select_loss :47-57,
+    select_optimizer :107-123, bandwidth accounting :152-163)
+"""
+
+from .tools import (  # noqa: F401
+    Context,
+    ClassRegister,
+    fatal,
+    info,
+    pairwise,
+    trace,
+    warning,
+)
+from .selectors import (  # noqa: F401
+    select_loss,
+    select_optimizer,
+    adjust_learning_rate,
+)

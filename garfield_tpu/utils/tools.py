@@ -1,0 +1,146 @@
+"""Logging, registries and misc helpers.
+
+Re-designed counterpart of pytorch_impl/libs/tools/__init__.py (colored
+context-scoped logging :34-122, fatal :201-249) and tools/misc.py
+(ClassRegister :118-172, pairwise :518-530, timing helpers :533-568).
+"""
+
+import itertools
+import sys
+import threading
+import time
+
+# ---------------------------------------------------------------------------
+# Colored, context-scoped logging (reference tools/__init__.py:34-122)
+
+_COLORS = {
+    "info": "\033[0m",
+    "warning": "\033[33m",
+    "error": "\033[31m",
+    "trace": "\033[90m",
+}
+_RESET = "\033[0m"
+_print_lock = threading.Lock()
+_use_color = sys.stderr.isatty()
+
+
+class Context:
+    """Scoped logging context: messages emitted inside a ``with Context(name)``
+    block are prefixed with the nesting path, mirroring the reference's
+    context-scoped logger (tools/__init__.py:34-122)."""
+
+    _local = threading.local()
+
+    def __init__(self, name):
+        self.name = str(name)
+
+    @classmethod
+    def _stack(cls):
+        if not hasattr(cls._local, "stack"):
+            cls._local.stack = []
+        return cls._local.stack
+
+    def __enter__(self):
+        self._stack().append(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        self._stack().pop()
+        return False
+
+    @classmethod
+    def prefix(cls):
+        stack = cls._stack()
+        return ("[" + "/".join(stack) + "] ") if stack else ""
+
+
+def _emit(level, *args):
+    text = Context.prefix() + " ".join(str(a) for a in args)
+    if _use_color:
+        text = _COLORS.get(level, "") + text + _RESET
+    with _print_lock:
+        print(text, file=sys.stderr, flush=True)
+
+
+def info(*args):
+    _emit("info", *args)
+
+
+def warning(*args):
+    _emit("warning", "[W]", *args)
+
+
+def trace(*args):
+    _emit("trace", *args)
+
+
+def fatal(*args, code=1):
+    """Print an error and exit (reference tools/__init__.py:201-249)."""
+    _emit("error", "[FATAL]", *args)
+    sys.exit(code)
+
+
+# ---------------------------------------------------------------------------
+# Class register (reference tools/misc.py:118-172)
+
+class ClassRegister:
+    """Named registry of classes/callables with listing and error reporting."""
+
+    def __init__(self, singular, plural=None):
+        self._singular = singular
+        self._plural = plural or (singular + "s")
+        self._register = {}
+
+    def register(self, name, cls):
+        if name in self._register:
+            raise KeyError(f"{self._singular} {name!r} already registered")
+        self._register[name] = cls
+        return cls
+
+    def itemize(self):
+        return sorted(self._register.keys())
+
+    def __contains__(self, name):
+        return name in self._register
+
+    def __getitem__(self, name):
+        try:
+            return self._register[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown {self._singular} {name!r}; available "
+                f"{self._plural}: {', '.join(self.itemize())}"
+            ) from None
+
+    def get(self, name, default=None):
+        return self._register.get(name, default)
+
+    def items(self):
+        return self._register.items()
+
+
+# ---------------------------------------------------------------------------
+# Iteration helpers (reference tools/misc.py:518-530)
+
+def pairwise(iterable):
+    """All unordered pairs (x, y), x before y, of an iterable."""
+    return itertools.combinations(iterable, 2)
+
+
+# ---------------------------------------------------------------------------
+# Timing helpers (reference tools/misc.py:533-568)
+
+class Timer:
+    """Wall-clock timer usable as a context manager; .elapsed in seconds."""
+
+    def __init__(self):
+        self.elapsed = 0.0
+        self._start = None
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._start
+        return False

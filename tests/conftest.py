@@ -1,0 +1,16 @@
+"""Test configuration: force a virtual 8-device CPU platform.
+
+This is the fake-backend the reference lacked (SURVEY §4): every distributed
+construct is testable single-process by running the SPMD program over
+XLA_FLAGS=--xla_force_host_platform_device_count=8. Must be set before jax
+is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

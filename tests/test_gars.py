@@ -1,0 +1,280 @@
+"""GAR unit tests: golden values vs independent float64 numpy oracles,
+plus the property tests the reference never had (SURVEY §4): permutation
+invariance, Byzantine exclusion, NaN resilience, contract checks.
+
+Oracles re-implement the reference rule semantics
+(pytorch_impl/libs/aggregators/*.py) literally — direct pairwise-difference
+norms, stable sorts — independent of the jax implementations under test.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from garfield_tpu.aggregators import gars
+
+
+RNG = np.random.default_rng(1234)
+
+
+def stack(n, d, scale=1.0):
+    return RNG.normal(size=(n, d)).astype(np.float32) * scale
+
+
+# ---------------------------------------------------------------------------
+# Numpy oracles (float64, reference semantics)
+
+def np_distances(g):
+    g = np.asarray(g, dtype=np.float64)
+    n = len(g)
+    dist = np.full((n, n), np.inf)
+    for i in range(n):
+        for j in range(n):
+            if i != j:
+                dd = np.linalg.norm(g[i] - g[j])
+                dist[i, j] = dd if np.isfinite(dd) else np.inf
+    return dist
+
+
+def np_krum(g, f, m=None):
+    g = np.asarray(g, dtype=np.float64)
+    n = len(g)
+    if m is None:
+        m = n - f - 2
+    dist = np_distances(g)
+    scores = np.array([np.sort(dist[i])[: n - f - 1].sum() for i in range(n)])
+    order = np.argsort(scores, kind="stable")
+    return g[order[:m]].mean(axis=0)
+
+
+def np_median(g):
+    g = np.asarray(g, dtype=np.float64)
+    n = len(g)
+    return np.sort(g, axis=0)[(n - 1) // 2]
+
+
+def np_aksel(g, f, mode="mid"):
+    g = np.asarray(g, dtype=np.float64)
+    n = len(g)
+    med = np_median(g)
+    dist = ((g - med) ** 2).sum(axis=1)
+    c = (n + 1) // 2 if mode == "mid" else n - f
+    order = np.argsort(dist, kind="stable")
+    return g[order[:c]].mean(axis=0)
+
+
+def np_brute(g, f):
+    import itertools
+
+    g = np.asarray(g, dtype=np.float64)
+    n = len(g)
+    dist = np_distances(g)
+    np.fill_diagonal(dist, 0.0)
+    best, best_diam = None, np.inf
+    for iset in itertools.combinations(range(n), n - f):
+        diam = max(dist[x, y] for x in iset for y in iset)
+        if diam < best_diam:
+            best, best_diam = iset, diam
+    return g[list(best)].mean(axis=0)
+
+
+def np_bulyan(g, f, m=None):
+    """Reference-intended Bulyan: per-round Multi-Krum over the active pool
+    (scores recomputed each round — the fixed semantics, see bulyan.py)."""
+    g = np.asarray(g, dtype=np.float64)
+    n = len(g)
+    m_max = n - f - 2
+    if m is None:
+        m = m_max
+    dist = np_distances(g)
+    active = list(range(n))
+    rounds = n - 2 * f - 2
+    selected = np.zeros((rounds, g.shape[1]))
+    for i in range(rounds):
+        m_i = min(m, m_max - i)
+        scores = []
+        for a in active:
+            dd = np.sort([dist[a, b] for b in active if b != a])
+            scores.append((dd[:m_i].sum(), a))
+        order = sorted(scores, key=lambda t: t[0])
+        chosen = [a for _, a in order[:m_i]]
+        selected[i] = g[chosen].mean(axis=0)
+        active.remove(order[0][1])
+    beta = rounds - 2 * f
+    med = np.sort(selected, axis=0)[(rounds - 1) // 2]
+    out = np.zeros(g.shape[1])
+    for j in range(g.shape[1]):
+        devs = np.abs(selected[:, j] - med[j])
+        idx = np.argsort(devs, kind="stable")[:beta]
+        out[j] = selected[idx, j].mean()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Golden tests
+
+@pytest.mark.parametrize("n,f,d", [(7, 2, 16), (11, 3, 33), (15, 4, 8)])
+def test_krum_golden(n, f, d):
+    g = stack(n, d)
+    got = np.asarray(gars["krum"](g, f=f))
+    want = np_krum(g, f)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("n,d", [(5, 7), (8, 16), (9, 3)])
+def test_median_golden(n, d):
+    g = stack(n, d)
+    got = np.asarray(gars["median"](g))
+    np.testing.assert_allclose(got, np_median(g), rtol=1e-6)
+
+
+@pytest.mark.parametrize("n,f,mode", [(7, 2, "mid"), (9, 3, "n-f"), (11, 2, "mid")])
+def test_aksel_golden(n, f, mode):
+    g = stack(n, 12)
+    got = np.asarray(gars["aksel"](g, f=f, mode=mode))
+    np.testing.assert_allclose(got, np_aksel(g, f, mode), rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n,f", [(5, 1), (7, 2), (9, 3)])
+def test_brute_golden(n, f):
+    g = stack(n, 10)
+    got = np.asarray(gars["brute"](g, f=f))
+    np.testing.assert_allclose(got, np_brute(g, f), rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n,f,d", [(7, 1, 9), (11, 2, 16), (12, 2, 5)])
+def test_bulyan_golden(n, f, d):
+    g = stack(n, d)
+    got = np.asarray(gars["bulyan"](g, f=f))
+    want = np_bulyan(g, f)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_average_golden():
+    g = stack(6, 11)
+    np.testing.assert_allclose(
+        np.asarray(gars["average"](g)), g.astype(np.float64).mean(axis=0), rtol=1e-6
+    )
+
+
+def test_condense_p1_is_median():
+    g = stack(8, 13)
+    import jax
+
+    got = np.asarray(gars["condense"](g, f=2, p=1.0, key=jax.random.key(0)))
+    np.testing.assert_allclose(got, np_median(g), rtol=1e-6)
+
+
+def test_condense_deterministic_per_key():
+    import jax
+
+    g = stack(8, 40)
+    k = jax.random.key(7)
+    a = np.asarray(gars["condense"](g, f=2, key=k))
+    b = np.asarray(gars["condense"](g, f=2, key=k))
+    np.testing.assert_array_equal(a, b)
+    # Output coordinates come from median or g[0] only.
+    med, g0 = np_median(g), g[0]
+    assert all(
+        np.isclose(x, m, atol=1e-6) or np.isclose(x, z, atol=1e-6)
+        for x, m, z in zip(a, med, g0)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Property tests
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("krum", {"f": 2}),
+    ("median", {}),
+    ("brute", {"f": 2}),
+    ("aksel", {"f": 2}),
+    ("bulyan", {"f": 1}),
+    ("average", {}),
+])
+def test_permutation_invariance(name, kwargs):
+    g = stack(9, 14)
+    perm = RNG.permutation(9)
+    a = np.asarray(gars[name](g, **kwargs))
+    b = np.asarray(gars[name](g[perm], **kwargs))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,f,n", [("krum", 2, 9), ("brute", 2, 9),
+                                      ("bulyan", 1, 8), ("aksel", 2, 9)])
+def test_byzantine_exclusion(name, f, n):
+    """f far-away Byzantine rows must not drag the output outside the honest
+    coordinate envelope (robustness property the GARs exist to provide)."""
+    g = stack(n, 10, scale=0.1)
+    g[:f] = 1e4  # Byzantine rows
+    out = np.asarray(gars[name](g, f=f))
+    honest = g[f:]
+    assert np.all(out <= honest.max(axis=0) + 1e-3)
+    assert np.all(out >= honest.min(axis=0) - 1e-3)
+
+
+@pytest.mark.parametrize("name,f", [("krum", 2), ("median", None), ("brute", 2)])
+def test_nan_resilience(name, f):
+    """A NaN-poisoned Byzantine row must not produce a NaN aggregate
+    (median.py NaN-resilience; krum/brute isfinite guards)."""
+    g = stack(9, 12)
+    g[0] = np.nan
+    kwargs = {} if f is None else {"f": f}
+    out = np.asarray(gars[name](g, **kwargs))
+    assert np.all(np.isfinite(out))
+
+
+def test_checked_contracts():
+    g = stack(5, 4)
+    with pytest.raises(AssertionError):
+        gars["krum"].checked(g, f=2)  # needs n >= 2f+3 = 7
+    with pytest.raises(AssertionError):
+        gars["bulyan"].checked(g, f=1)  # needs n >= 4f+3 = 7
+    with pytest.raises(AssertionError):
+        gars["brute"].checked(g, f=3)  # needs n >= 2f+1 = 7
+    assert gars["krum"].check(stack(7, 4), f=2) is None
+
+
+def test_upper_bounds_match_reference_formulas():
+    import math
+
+    n, f, d = 20, 4, 1000
+    assert gars["median"].upper_bound(n, f, d) == pytest.approx(1 / math.sqrt(n - f))
+    assert gars["krum"].upper_bound(n, f, d) == pytest.approx(
+        1 / math.sqrt(2 * (n - f + f * (n + f * (n - f - 2) - 2) / (n - 2 * f - 2)))
+    )
+    assert gars["brute"].upper_bound(n, f, d) == pytest.approx((n - f) / (2 * f))
+
+
+def test_influence_far_attacks_rejected():
+    honest = stack(9, 8, scale=0.1)
+    attacks = np.full((2, 8), 1e4, dtype=np.float32)
+    assert gars["krum"].influence(list(honest), list(attacks), f=2) == 0.0
+    assert gars["brute"].influence(list(honest), list(attacks), f=2) == 0.0
+    assert gars["average"].influence(list(honest), list(attacks)) == pytest.approx(2 / 11)
+
+
+def test_list_and_stack_inputs_agree():
+    g = stack(7, 6)
+    a = np.asarray(gars["krum"](g, f=2))
+    b = np.asarray(gars["krum"]([jnp.asarray(row) for row in g], f=2))
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_jit_compatible():
+    import functools
+    import jax
+
+    g = stack(9, 32)
+    for name, kwargs in [("krum", {"f": 2}), ("median", {}), ("bulyan", {"f": 1}),
+                         ("aksel", {"f": 2}), ("average", {})]:
+        fn = jax.jit(functools.partial(gars[name].unchecked, **kwargs))
+        eager = np.asarray(gars[name](g, **kwargs))
+        jitted = np.asarray(fn(g))
+        np.testing.assert_allclose(jitted, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_registry_contents():
+    for name in ("average", "median", "krum", "bulyan", "brute", "aksel", "condense"):
+        assert name in gars, f"GAR {name} missing from registry"
