@@ -1,0 +1,173 @@
+"""Byzantine fault injection as pure, jit'd value transforms.
+
+TPU-native counterpart of the reference's attack components:
+  - gradient attacks: ``pytorch_impl/libs/garfieldpp/byzWorker.py`` (attack
+    table :62-68, attacks :78-143) and ``tensorflow_impl/libs/attacker.py``
+    (:36-127);
+  - model attacks:    ``pytorch_impl/libs/garfieldpp/byzServer.py`` (attack
+    table :74-78, attacks :86-108).
+
+Design shift (SURVEY §7): the reference injects faults by *subclassing the
+node role* and replacing its RPC response. On a TPU mesh every worker slot is
+an SPMD shard of one jit'd program, so Byzantine behavior becomes a **value
+transformation of the gathered gradient stack**: compute honest gradients for
+every slot, then rewrite the rows selected by a boolean ``byz_mask``. This
+keeps the whole fault-injection path on-device, inside jit, and differentiably
+close to the reference semantics:
+
+  - colluding attacks (lie / empire) need the ``fw`` honest gradients of the
+    Byzantine cohort (byzWorker.py:114-117 computes them locally from extra
+    batches); here the cohort's honest rows are already in the stack, so the
+    collusion statistics (mu, sigma) are masked reductions over those rows;
+  - randomized attacks thread an explicit ``jax.random`` key instead of torch
+    global RNG, keeping steps reproducible and replay-exact.
+
+Registries mirror the reference dicts:
+  ``gradient_attacks``: random, reverse, drop, lie, empire
+  ``model_attacks``:    random, reverse, drop
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "gradient_attacks",
+    "model_attacks",
+    "apply_gradient_attack",
+    "apply_model_attack",
+]
+
+
+def _masked_moments(g, mask):
+    """Mean and unbiased std over the rows of ``g`` selected by ``mask``.
+
+    Matches ``torch.mean``/``torch.std`` over the stacked cohort gradients
+    (byzWorker.py:119-121): std is Bessel-corrected (ddof=1), so a cohort of
+    one (fw=1) yields sigma=NaN exactly as torch does — downstream GARs treat
+    the resulting non-finite gradient as infinitely distant (krum.py:46-48),
+    reproducing the reference's emergent behavior.
+    """
+    w = mask.astype(g.dtype)[:, None]
+    count = jnp.sum(w)
+    mu = jnp.sum(w * g, axis=0) / count
+    var = jnp.sum(w * (g - mu[None, :]) ** 2, axis=0) / (count - 1.0)
+    return mu, jnp.sqrt(var)
+
+
+# --- gradient attacks (byzWorker.py:78-143) --------------------------------
+
+
+def random_attack(g, mask, *, key, **_):
+    """Replace Byzantine rows with uniform[0,1) noise (byzWorker.py:78-85)."""
+    fake = jax.random.uniform(key, g.shape, dtype=g.dtype)
+    return jnp.where(mask[:, None], fake, g)
+
+
+def reverse_attack(g, mask, *, factor=-100.0, **_):
+    """Amplified sign-flip: grad * -100 (byzWorker.py:87-94)."""
+    return jnp.where(mask[:, None], g * factor, g)
+
+
+def drop_attack(g, mask, *, key, p=0.3, **_):
+    """Zero out a random 30% of coordinates (byzWorker.py:96-106)."""
+    drop = jax.random.uniform(key, g.shape) > (1.0 - p)
+    return jnp.where(mask[:, None] & drop, 0.0, g)
+
+
+def lie_attack(g, mask, *, z=1.035, **_):
+    """Little-is-enough: mu + z*sigma over the colluding cohort's honest
+    gradients (byzWorker.py:108-125; z_max=1.035 precomputed for n=20, f=8).
+    """
+    mu, sigma = _masked_moments(g, mask)
+    fake = mu + z * sigma
+    return jnp.where(mask[:, None], fake[None, :], g)
+
+
+def empire_attack(g, mask, *, eps=10.0, **_):
+    """Fall-of-empires: -eps * mu over the colluding cohort
+    (byzWorker.py:127-143; eps=10, empirical).
+    """
+    mu, _ = _masked_moments(g, mask)
+    fake = -eps * mu
+    return jnp.where(mask[:, None], fake[None, :], g)
+
+
+gradient_attacks = {
+    "random": random_attack,
+    "reverse": reverse_attack,
+    "drop": drop_attack,
+    "lie": lie_attack,
+    "empire": empire_attack,
+}
+
+
+def apply_gradient_attack(attack, gradients, byz_mask, *, key=None, **params):
+    """Rewrite the Byzantine rows of a (n, d) gradient stack.
+
+    Args:
+      attack: name in ``gradient_attacks`` (byzWorker.py:62-68 table), or
+        None/"none" for fault-free passthrough.
+      gradients: (n, d) stack — one row per logical worker slot.
+      byz_mask: (n,) bool — True rows are Byzantine.
+      key: jax PRNG key; required by the randomized attacks (random, drop).
+      **params: attack knobs (z, eps, p, factor) with reference defaults.
+
+    Returns the poisoned (n, d) stack; honest rows are returned untouched.
+    """
+    if attack is None or attack == "none":
+        return gradients
+    if attack not in gradient_attacks:
+        raise ValueError(
+            f"unknown attack {attack!r}; available: {sorted(gradient_attacks)}"
+        )
+    fn = gradient_attacks[attack]
+    mask = jnp.asarray(byz_mask, dtype=bool)
+    if fn in (random_attack, drop_attack):
+        if key is None:
+            raise ValueError(f"attack {attack!r} needs a PRNG key")
+        return fn(gradients, mask, key=key, **params)
+    return fn(gradients, mask, **params)
+
+
+# --- model attacks (byzServer.py:86-108) -----------------------------------
+
+
+def model_random_attack(m, *, key, **_):
+    """Random model of the same shape (byzServer.py:86-91)."""
+    return jax.random.uniform(key, m.shape, dtype=m.dtype)
+
+
+def model_reverse_attack(m, *, factor=-100.0, **_):
+    """model * -100 (byzServer.py:93-98)."""
+    return m * factor
+
+
+def model_drop_attack(m, *, key, p=0.3, **_):
+    """Zero a random 30% of model coordinates (byzServer.py:100-108)."""
+    drop = jax.random.uniform(key, m.shape) > (1.0 - p)
+    return jnp.where(drop, 0.0, m)
+
+
+model_attacks = {
+    "random": model_random_attack,
+    "reverse": model_reverse_attack,
+    "drop": model_drop_attack,
+}
+
+
+def apply_model_attack(attack, model_vec, *, key=None, **params):
+    """Poison a flattened model vector a Byzantine PS would serve
+    (byzServer.py:80-84 dispatch). ``attack`` None/"none" is passthrough.
+    """
+    if attack is None or attack == "none":
+        return model_vec
+    if attack not in model_attacks:
+        raise ValueError(
+            f"unknown model attack {attack!r}; available: {sorted(model_attacks)}"
+        )
+    fn = model_attacks[attack]
+    if fn in (model_random_attack, model_drop_attack):
+        if key is None:
+            raise ValueError(f"model attack {attack!r} needs a PRNG key")
+        return fn(model_vec, key=key, **params)
+    return fn(model_vec, **params)

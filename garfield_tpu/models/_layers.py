@@ -1,0 +1,68 @@
+"""Shared building blocks for the CNN zoo.
+
+TPU-first conventions used across the zoo (counterpart of the torch zoo in
+pytorch_impl/libs/garfieldpp/models/):
+  - NHWC layout (XLA's native conv layout on TPU; torch is NCHW);
+  - every module takes ``train: bool`` and routes BatchNorm through the
+    ``batch_stats`` collection, dropout through the ``dropout`` rng;
+  - ``dtype`` threads a compute dtype (bfloat16 on TPU for MXU-friendly
+    convs) while parameters stay float32 (``param_dtype``).
+"""
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["conv", "conv1x1", "norm", "max_pool", "avg_pool", "global_avg_pool"]
+
+
+def conv(features, kernel, stride=1, *, padding="SAME", groups=1, use_bias=False,
+         dtype=jnp.float32, name=None):
+    """3x3-style conv with torch-like defaults (no bias before BN)."""
+    if isinstance(kernel, int):
+        kernel = (kernel, kernel)
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    return nn.Conv(
+        features, kernel, strides=stride, padding=padding,
+        feature_group_count=groups, use_bias=use_bias, dtype=dtype, name=name,
+    )
+
+
+conv1x1 = partial(conv, kernel=1, padding="VALID")
+
+
+def norm(train, *, dtype=jnp.float32, name=None):
+    """BatchNorm with torch defaults (momentum 0.9, eps 1e-5)."""
+    return nn.BatchNorm(
+        use_running_average=not train, momentum=0.9, epsilon=1e-5,
+        dtype=dtype, name=name,
+    )
+
+
+def max_pool(x, window=2, stride=None, padding="VALID"):
+    stride = window if stride is None else stride
+    if isinstance(window, int):
+        window = (window, window)
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    return nn.max_pool(x, window, strides=stride, padding=padding)
+
+
+def avg_pool(x, window=2, stride=None, padding="VALID"):
+    stride = window if stride is None else stride
+    if isinstance(window, int):
+        window = (window, window)
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    return nn.avg_pool(x, window, strides=stride, padding=padding)
+
+
+def global_avg_pool(x):
+    """NHWC global average pool -> (N, C)."""
+    return jnp.mean(x, axis=(1, 2))

@@ -1,0 +1,77 @@
+"""DenseNet-BC family (counterpart of garfieldpp/models/densenet.py)."""
+
+import math
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import avg_pool, conv, conv1x1, global_avg_pool, norm
+
+
+class Bottleneck(nn.Module):
+    growth_rate: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        out = conv1x1(4 * self.growth_rate, dtype=self.dtype)(
+            nn.relu(norm(train, dtype=self.dtype)(x)))
+        out = conv(self.growth_rate, 3, 1, padding=1, dtype=self.dtype)(
+            nn.relu(norm(train, dtype=self.dtype)(out)))
+        return jnp.concatenate([out, x], axis=-1)
+
+
+class Transition(nn.Module):
+    out_planes: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = conv1x1(self.out_planes, dtype=self.dtype)(
+            nn.relu(norm(train, dtype=self.dtype)(x)))
+        return avg_pool(x, 2)
+
+
+class DenseNet(nn.Module):
+    nblocks: Sequence[int]
+    growth_rate: int = 12
+    reduction: float = 0.5
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        gr = self.growth_rate
+        planes = 2 * gr
+        x = conv(planes, 3, 1, padding=1, dtype=self.dtype)(x)
+        for i, nb in enumerate(self.nblocks):
+            for _ in range(nb):
+                x = Bottleneck(gr, dtype=self.dtype)(x, train)
+            planes += nb * gr
+            if i != len(self.nblocks) - 1:
+                planes = int(math.floor(planes * self.reduction))
+                x = Transition(planes, dtype=self.dtype)(x, train)
+        x = nn.relu(norm(train, dtype=self.dtype)(x))
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def DenseNet121(num_classes=10, dtype=jnp.float32):
+    return DenseNet((6, 12, 24, 16), 32, 0.5, num_classes, dtype)
+
+
+def DenseNet169(num_classes=10, dtype=jnp.float32):
+    return DenseNet((6, 12, 32, 32), 32, 0.5, num_classes, dtype)
+
+
+def DenseNet201(num_classes=10, dtype=jnp.float32):
+    return DenseNet((6, 12, 48, 32), 32, 0.5, num_classes, dtype)
+
+
+def DenseNet161(num_classes=10, dtype=jnp.float32):
+    return DenseNet((6, 12, 36, 24), 48, 0.5, num_classes, dtype)
+
+
+def densenet_cifar(num_classes=10, dtype=jnp.float32):
+    return DenseNet((6, 12, 24, 16), 12, 0.5, num_classes, dtype)

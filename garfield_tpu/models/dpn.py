@@ -1,0 +1,67 @@
+"""Dual Path Networks (counterpart of garfieldpp/models/dpn.py)."""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, conv1x1, global_avg_pool, norm
+
+
+class DPNBottleneck(nn.Module):
+    in_planes: int
+    out_planes: int
+    dense_depth: int
+    stride: int
+    first_layer: bool
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        out = nn.relu(norm(train, dtype=d)(conv1x1(self.in_planes, dtype=d)(x)))
+        out = nn.relu(norm(train, dtype=d)(
+            conv(self.in_planes, 3, self.stride, padding=1, groups=32, dtype=d)(out)))
+        out = norm(train, dtype=d)(
+            conv1x1(self.out_planes + self.dense_depth, dtype=d)(out))
+        if self.first_layer:
+            x = norm(train, dtype=d)(
+                conv1x1(self.out_planes + self.dense_depth, stride=self.stride,
+                        dtype=d)(x))
+        res_x, dense_x = x[..., : self.out_planes], x[..., self.out_planes :]
+        res_o, dense_o = out[..., : self.out_planes], out[..., self.out_planes :]
+        out = jnp.concatenate(
+            [res_x + res_o, dense_x, dense_o], axis=-1)
+        return nn.relu(out)
+
+
+class DPN(nn.Module):
+    in_planes: Sequence[int]
+    out_planes: Sequence[int]
+    num_blocks: Sequence[int]
+    dense_depth: Sequence[int]
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        x = nn.relu(norm(train, dtype=d)(conv(64, 3, 1, padding=1, dtype=d)(x)))
+        for stage in range(4):
+            ip, op = self.in_planes[stage], self.out_planes[stage]
+            nb, dd = self.num_blocks[stage], self.dense_depth[stage]
+            strides = [1 if stage == 0 else 2] + [1] * (nb - 1)
+            for i, s in enumerate(strides):
+                x = DPNBottleneck(ip, op, dd, s, i == 0, dtype=d)(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=d)(x)
+
+
+def DPN26(num_classes=10, dtype=jnp.float32):
+    return DPN((96, 192, 384, 768), (256, 512, 1024, 2048),
+               (2, 2, 2, 2), (16, 32, 24, 128), num_classes, dtype)
+
+
+def DPN92(num_classes=10, dtype=jnp.float32):
+    return DPN((96, 192, 384, 768), (256, 512, 1024, 2048),
+               (3, 4, 20, 3), (16, 32, 24, 128), num_classes, dtype)

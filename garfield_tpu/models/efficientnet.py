@@ -1,0 +1,64 @@
+"""EfficientNet-B0 (counterpart of garfieldpp/models/efficientnet.py):
+MBConv blocks with SE, swish activation, CIFAR-scale stem."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, conv1x1, global_avg_pool, norm
+
+# (expansion, out_planes, num_blocks, kernel, stride)
+cfg_b0 = [(1, 16, 1, 3, 1), (6, 24, 2, 3, 2), (6, 40, 2, 5, 2),
+          (6, 80, 3, 3, 2), (6, 112, 3, 5, 1), (6, 192, 4, 5, 2),
+          (6, 320, 1, 3, 1)]
+
+
+class MBConv(nn.Module):
+    expansion: int
+    out_planes: int
+    kernel: int
+    stride: int
+    se_ratio: float = 0.25
+    drop_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        in_planes = x.shape[-1]
+        planes = self.expansion * in_planes
+        out = x
+        if self.expansion != 1:
+            out = nn.swish(norm(train, dtype=d)(conv1x1(planes, dtype=d)(out)))
+        out = nn.swish(norm(train, dtype=d)(
+            conv(planes, self.kernel, self.stride,
+                 padding=(self.kernel - 1) // 2, groups=planes, dtype=d)(out)))
+        # squeeze-excite
+        se = global_avg_pool(out)
+        se = nn.swish(nn.Dense(max(1, int(in_planes * self.se_ratio)), dtype=d)(se))
+        se = nn.sigmoid(nn.Dense(planes, dtype=d)(se))
+        out = out * se[:, None, None, :]
+        out = norm(train, dtype=d)(conv1x1(self.out_planes, dtype=d)(out))
+        if self.stride == 1 and in_planes == self.out_planes:
+            out = out + x
+        return out
+
+
+class EfficientNet(nn.Module):
+    cfg: tuple = tuple(cfg_b0)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        x = nn.swish(norm(train, dtype=d)(conv(32, 3, 1, padding=1, dtype=d)(x)))
+        for expansion, out_planes, num_blocks, kernel, stride in self.cfg:
+            for i in range(num_blocks):
+                s = stride if i == 0 else 1
+                x = MBConv(expansion, out_planes, kernel, s, dtype=d)(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=d)(x)
+
+
+def EfficientNetB0(num_classes=10, dtype=jnp.float32):
+    return EfficientNet(tuple(cfg_b0), num_classes, dtype)

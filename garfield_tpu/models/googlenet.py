@@ -1,0 +1,56 @@
+"""GoogLeNet / Inception-v1 (counterpart of garfieldpp/models/googlenet.py).
+Also registered under "inception" (the reference maps that name to
+torchvision's inception_v3, garfieldpp/tools.py:73; here the v1 graph serves
+both names — documented deviation, CIFAR-scale inputs don't fit v3's 299px
+stem anyway)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import avg_pool, conv, conv1x1, global_avg_pool, max_pool, norm
+
+
+class Inception(nn.Module):
+    n1x1: int
+    n3x3red: int
+    n3x3: int
+    n5x5red: int
+    n5x5: int
+    pool_planes: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        def cbr(feats, kernel, pad, y):
+            return nn.relu(norm(train, dtype=self.dtype)(
+                conv(feats, kernel, 1, padding=pad, dtype=self.dtype)(y)))
+
+        b1 = cbr(self.n1x1, 1, 0, x)
+        b2 = cbr(self.n3x3, 3, 1, cbr(self.n3x3red, 1, 0, x))
+        b3 = cbr(self.n5x5red, 1, 0, x)
+        b3 = cbr(self.n5x5, 3, 1, cbr(self.n5x5, 3, 1, b3))
+        b4 = cbr(self.pool_planes, 1, 0, max_pool(x, 3, 1, padding=1))
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class GoogLeNet(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        x = nn.relu(norm(train, dtype=d)(conv(192, 3, 1, padding=1, dtype=d)(x)))
+        x = Inception(64, 96, 128, 16, 32, 32, d)(x, train)
+        x = Inception(128, 128, 192, 32, 96, 64, d)(x, train)
+        x = max_pool(x, 3, 2, padding=1)
+        x = Inception(192, 96, 208, 16, 48, 64, d)(x, train)
+        x = Inception(160, 112, 224, 24, 64, 64, d)(x, train)
+        x = Inception(128, 128, 256, 24, 64, 64, d)(x, train)
+        x = Inception(112, 144, 288, 32, 64, 64, d)(x, train)
+        x = Inception(256, 160, 320, 32, 128, 128, d)(x, train)
+        x = max_pool(x, 3, 2, padding=1)
+        x = Inception(256, 160, 320, 32, 128, 128, d)(x, train)
+        x = Inception(384, 192, 384, 48, 128, 128, d)(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=d)(x)

@@ -1,0 +1,22 @@
+"""LeNet-5 (counterpart of garfieldpp/models/lenet.py)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import max_pool
+
+
+class LeNet(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.relu(nn.Conv(6, (5, 5), padding="VALID", dtype=self.dtype)(x))
+        x = max_pool(x, 2)
+        x = nn.relu(nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x))
+        x = max_pool(x, 2)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

@@ -1,0 +1,41 @@
+"""MobileNet v1 (counterpart of garfieldpp/models/mobilenet.py):
+depthwise-separable conv stacks, CIFAR-scale."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, conv1x1, global_avg_pool, norm
+
+# (out_planes, stride) table; int means stride 1.
+cfg = [64, (128, 2), 128, (256, 2), 256, (512, 2),
+       512, 512, 512, 512, 512, (1024, 2), 1024]
+
+
+class Block(nn.Module):
+    out_planes: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_planes = x.shape[-1]
+        x = nn.relu(norm(train, dtype=self.dtype)(
+            conv(in_planes, 3, self.stride, padding=1, groups=in_planes,
+                 dtype=self.dtype)(x)))
+        return nn.relu(norm(train, dtype=self.dtype)(
+            conv1x1(self.out_planes, dtype=self.dtype)(x)))
+
+
+class MobileNet(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.relu(norm(train, dtype=self.dtype)(
+            conv(32, 3, 1, padding=1, dtype=self.dtype)(x)))
+        for v in cfg:
+            out, stride = (v, 1) if isinstance(v, int) else v
+            x = Block(out, stride, dtype=self.dtype)(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

@@ -1,0 +1,50 @@
+"""MobileNetV2 (counterpart of garfieldpp/models/mobilenetv2.py):
+inverted residual blocks, CIFAR-scale."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, conv1x1, global_avg_pool, norm
+
+# (expansion, out_planes, num_blocks, stride)
+cfg = [(1, 16, 1, 1), (6, 24, 2, 1), (6, 32, 3, 2), (6, 64, 4, 2),
+       (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+
+
+class InvertedResidual(nn.Module):
+    expansion: int
+    out_planes: int
+    stride: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        in_planes = x.shape[-1]
+        planes = self.expansion * in_planes
+        out = nn.relu(norm(train, dtype=d)(conv1x1(planes, dtype=d)(x)))
+        out = nn.relu(norm(train, dtype=d)(
+            conv(planes, 3, self.stride, padding=1, groups=planes, dtype=d)(out)))
+        out = norm(train, dtype=d)(conv1x1(self.out_planes, dtype=d)(out))
+        if self.stride == 1:
+            shortcut = x if in_planes == self.out_planes else norm(train, dtype=d)(
+                conv1x1(self.out_planes, dtype=d)(x))
+            out = out + shortcut
+        return out
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        x = nn.relu(norm(train, dtype=d)(conv(32, 3, 1, padding=1, dtype=d)(x)))
+        for expansion, out_planes, num_blocks, stride in cfg:
+            for i in range(num_blocks):
+                s = stride if i == 0 else 1
+                x = InvertedResidual(expansion, out_planes, s, dtype=d)(x, train)
+        x = nn.relu(norm(train, dtype=d)(conv1x1(1280, dtype=d)(x)))
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=d)(x)

@@ -1,0 +1,73 @@
+"""Hand-crafted small nets (counterpart of garfieldpp/models/nets.py).
+
+``Net`` (the "convnet" MNIST model, nets.py:59-77), ``Cifarnet``
+(nets.py:40-57) and ``CNNet`` (nets.py:79-135) with identical layer graphs,
+in NHWC flax.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import max_pool, norm
+
+
+class Net(nn.Module):
+    """MNIST convnet (nets.py:59-77): conv5x5(10) -> pool -> conv5x5(20) +
+    dropout2d -> pool -> fc50 -> dropout -> fc -> log_softmax."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Conv(10, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(max_pool(x, 2))
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        # torch Dropout2d zeroes whole channels (p=0.5 default).
+        x = nn.Dropout(0.5, broadcast_dims=(1, 2), deterministic=not train)(x)
+        x = nn.relu(max_pool(x, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(50, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return nn.log_softmax(x)
+
+
+class Cifarnet(nn.Module):
+    """CIFAR-10 LeNet-style net (nets.py:40-57)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = max_pool(nn.relu(nn.Conv(6, (5, 5), padding="VALID", dtype=self.dtype)(x)), 2)
+        x = max_pool(nn.relu(nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x)), 2)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+class CNNet(nn.Module):
+    """Three conv blocks + 3-layer head (nets.py:79-135)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        for block, feats in enumerate((32, 128, 256)):
+            x = nn.Conv(feats, (3, 3), padding=1, use_bias=True, dtype=self.dtype)(x)
+            x = nn.relu(norm(train, dtype=self.dtype)(x))
+            x = nn.Conv(feats * 2 if block == 0 else feats, (3, 3), padding=1,
+                        use_bias=True, dtype=self.dtype)(x)
+            x = max_pool(nn.relu(x), 2)
+            if block == 1:
+                x = nn.Dropout(0.05, broadcast_dims=(1, 2), deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.1, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(1024, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        x = nn.Dropout(0.1, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

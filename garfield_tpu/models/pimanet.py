@@ -1,0 +1,17 @@
+"""MLP for the Pima Indians Diabetes task (garfieldpp/models/pimanet.py:4-18):
+8 -> 64 -> 64 -> num_classes with a sigmoid output, trained with BCE."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class PimaNet(nn.Module):
+    num_classes: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(64, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(64, dtype=self.dtype)(x))
+        return nn.sigmoid(nn.Dense(self.num_classes, dtype=self.dtype)(x))

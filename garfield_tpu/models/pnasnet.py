@@ -1,0 +1,92 @@
+"""PNASNet A/B (counterpart of garfieldpp/models/pnasnet.py): progressive
+NAS cells — sep-conv and sep-conv+maxpool cell types."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, conv1x1, global_avg_pool, max_pool, norm
+
+
+class SepConv(nn.Module):
+    out_planes: int
+    kernel: int
+    stride: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        in_planes = x.shape[-1]
+        x = conv(in_planes, self.kernel, self.stride,
+                 padding=(self.kernel - 1) // 2, groups=in_planes, dtype=d)(x)
+        x = conv1x1(self.out_planes, dtype=d)(x)
+        return norm(train, dtype=d)(x)
+
+
+class CellA(nn.Module):
+    out_planes: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        y1 = SepConv(self.out_planes, 7, self.stride, dtype=d)(x, train)
+        y2 = max_pool(x, 3, self.stride, padding=1)
+        if self.stride == 2 or x.shape[-1] != self.out_planes:
+            y2 = norm(train, dtype=d)(conv1x1(self.out_planes, dtype=d)(y2))
+        return nn.relu(y1 + y2)
+
+
+class CellB(nn.Module):
+    out_planes: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        # branch 1: two sep convs
+        y1 = SepConv(self.out_planes, 3, self.stride, dtype=d)(x, train)
+        y2 = SepConv(self.out_planes, 7, self.stride, dtype=d)(x, train)
+        # branch 2: sep conv + maxpool
+        y3 = max_pool(x, 3, self.stride, padding=1)
+        if self.stride == 2 or x.shape[-1] != self.out_planes:
+            y3 = norm(train, dtype=d)(conv1x1(self.out_planes, dtype=d)(y3))
+        y4 = SepConv(self.out_planes, 5, self.stride, dtype=d)(x, train)
+        b1 = nn.relu(y1 + y2)
+        b2 = nn.relu(y3 + y4)
+        return norm(train, dtype=d)(
+            conv1x1(self.out_planes, dtype=d)(
+                nn.relu(jnp.concatenate([b1, b2], axis=-1))))
+
+
+class PNASNet(nn.Module):
+    cell_type: str  # "A" or "B"
+    num_cells: int
+    num_planes: int
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        cell = CellA if self.cell_type == "A" else CellB
+        planes = self.num_planes
+        x = nn.relu(norm(train, dtype=d)(conv(planes, 3, 1, padding=1, dtype=d)(x)))
+        for stage in range(3):
+            for _ in range(self.num_cells):
+                x = cell(planes, 1, dtype=d)(x, train)
+            if stage < 2:
+                planes *= 2
+                x = cell(planes, 2, dtype=d)(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=d)(x)
+
+
+def PNASNetA(num_classes=10, dtype=jnp.float32):
+    return PNASNet("A", 6, 44, num_classes, dtype)
+
+
+def PNASNetB(num_classes=10, dtype=jnp.float32):
+    return PNASNet("B", 6, 32, num_classes, dtype)

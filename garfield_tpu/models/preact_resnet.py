@@ -1,0 +1,45 @@
+"""Pre-activation ResNet (counterpart of garfieldpp/models/preact_resnet.py)."""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, conv1x1, global_avg_pool, norm
+
+
+class PreActBlock(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        out = nn.relu(norm(train, dtype=self.dtype)(x))
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.features:
+            shortcut = conv1x1(self.features, stride=self.stride, dtype=self.dtype)(out)
+        out = conv(self.features, 3, self.stride, padding=1, dtype=self.dtype)(out)
+        out = conv(self.features, 3, 1, padding=1, dtype=self.dtype)(
+            nn.relu(norm(train, dtype=self.dtype)(out)))
+        return out + shortcut
+
+
+class PreActResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = conv(64, 3, 1, padding=1, dtype=self.dtype)(x)
+        for stage, nblocks in enumerate(self.stage_sizes):
+            for i in range(nblocks):
+                stride = 2 if stage > 0 and i == 0 else 1
+                x = PreActBlock(64 * 2 ** stage, stride, dtype=self.dtype)(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def PreActResNet18(num_classes=10, dtype=jnp.float32):
+    return PreActResNet((2, 2, 2, 2), num_classes, dtype)

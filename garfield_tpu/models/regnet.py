@@ -1,0 +1,74 @@
+"""RegNetX (counterpart of garfieldpp/models/regnet.py): grouped bottleneck
+stages with SE option."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, conv1x1, global_avg_pool, norm
+
+
+class RegNetBlock(nn.Module):
+    w_out: int
+    stride: int
+    group_width: int
+    bottleneck_ratio: int = 1
+    se_ratio: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        w_b = int(round(self.w_out / self.bottleneck_ratio))
+        groups = w_b // self.group_width
+        out = nn.relu(norm(train, dtype=d)(conv1x1(w_b, dtype=d)(x)))
+        out = nn.relu(norm(train, dtype=d)(
+            conv(w_b, 3, self.stride, padding=1, groups=groups, dtype=d)(out)))
+        if self.se_ratio > 0:
+            se = global_avg_pool(out)
+            se = nn.relu(nn.Dense(int(x.shape[-1] * self.se_ratio), dtype=d)(se))
+            se = nn.sigmoid(nn.Dense(w_b, dtype=d)(se))
+            out = out * se[:, None, None, :]
+        out = norm(train, dtype=d)(conv1x1(self.w_out, dtype=d)(out))
+        if self.stride != 1 or x.shape[-1] != self.w_out:
+            x = norm(train, dtype=d)(
+                conv1x1(self.w_out, stride=self.stride, dtype=d)(x))
+        return nn.relu(out + x)
+
+
+class RegNet(nn.Module):
+    depths: tuple
+    widths: tuple
+    strides: tuple
+    group_width: int
+    bottleneck_ratio: int = 1
+    se_ratio: float = 0.0
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        x = nn.relu(norm(train, dtype=d)(conv(64, 3, 1, padding=1, dtype=d)(x)))
+        for stage in range(len(self.depths)):
+            for i in range(self.depths[stage]):
+                stride = self.strides[stage] if i == 0 else 1
+                x = RegNetBlock(self.widths[stage], stride, self.group_width,
+                                self.bottleneck_ratio, self.se_ratio,
+                                dtype=d)(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=d)(x)
+
+
+def RegNetX_200MF(num_classes=10, dtype=jnp.float32):
+    return RegNet((1, 1, 4, 7), (24, 56, 152, 368), (1, 1, 2, 2), 8,
+                  1, 0.0, num_classes, dtype)
+
+
+def RegNetX_400MF(num_classes=10, dtype=jnp.float32):
+    return RegNet((1, 2, 7, 12), (32, 64, 160, 384), (1, 1, 2, 2), 16,
+                  1, 0.0, num_classes, dtype)
+
+
+def RegNetY_400MF(num_classes=10, dtype=jnp.float32):
+    return RegNet((1, 2, 7, 12), (32, 64, 160, 384), (1, 1, 2, 2), 16,
+                  1, 0.25, num_classes, dtype)

@@ -1,0 +1,89 @@
+"""CIFAR-style ResNet family (counterpart of garfieldpp/models/resnet.py).
+
+3x3 stem (no maxpool) as in the CIFAR zoo; BasicBlock for 18/34,
+Bottleneck for 50/101/152. The reference's resnet50/152 come from
+torchvision (garfieldpp/tools.py:70-72) but share this block structure.
+"""
+
+from typing import Sequence, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, conv1x1, global_avg_pool, norm
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        out = nn.relu(norm(train, dtype=self.dtype)(
+            conv(self.features, 3, self.stride, padding=1, dtype=self.dtype)(x)))
+        out = norm(train, dtype=self.dtype)(
+            conv(self.features, 3, 1, padding=1, dtype=self.dtype)(out))
+        if self.stride != 1 or x.shape[-1] != self.features:
+            x = norm(train, dtype=self.dtype)(
+                conv1x1(self.features, stride=self.stride, dtype=self.dtype)(x))
+        return nn.relu(out + x)
+
+
+class Bottleneck(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        out = nn.relu(norm(train, dtype=self.dtype)(
+            conv1x1(self.features, dtype=self.dtype)(x)))
+        out = nn.relu(norm(train, dtype=self.dtype)(
+            conv(self.features, 3, self.stride, padding=1, dtype=self.dtype)(out)))
+        out = norm(train, dtype=self.dtype)(
+            conv1x1(self.features * 4, dtype=self.dtype)(out))
+        if self.stride != 1 or x.shape[-1] != self.features * 4:
+            x = norm(train, dtype=self.dtype)(
+                conv1x1(self.features * 4, stride=self.stride, dtype=self.dtype)(x))
+        return nn.relu(out + x)
+
+
+class ResNet(nn.Module):
+    block: Type[nn.Module]
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.relu(norm(train, dtype=self.dtype)(
+            conv(64, 3, 1, padding=1, dtype=self.dtype)(x)))
+        for stage, nblocks in enumerate(self.stage_sizes):
+            for i in range(nblocks):
+                stride = 2 if stage > 0 and i == 0 else 1
+                x = self.block(64 * 2 ** stage, stride, dtype=self.dtype)(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def ResNet18(num_classes=10, dtype=jnp.float32):
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes, dtype)
+
+
+def ResNet34(num_classes=10, dtype=jnp.float32):
+    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes, dtype)
+
+
+def ResNet50(num_classes=10, dtype=jnp.float32):
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes, dtype)
+
+
+def ResNet101(num_classes=10, dtype=jnp.float32):
+    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes, dtype)
+
+
+def ResNet152(num_classes=10, dtype=jnp.float32):
+    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes, dtype)

@@ -1,0 +1,65 @@
+"""ResNeXt (counterpart of garfieldpp/models/resnext.py): grouped 3x3
+bottlenecks, CIFAR 29-layer variants."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, conv1x1, global_avg_pool, norm
+
+
+class ResNeXtBlock(nn.Module):
+    cardinality: int
+    bottleneck_width: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        group_width = self.cardinality * self.bottleneck_width
+        out = nn.relu(norm(train, dtype=d)(conv1x1(group_width, dtype=d)(x)))
+        out = nn.relu(norm(train, dtype=d)(
+            conv(group_width, 3, self.stride, padding=1,
+                 groups=self.cardinality, dtype=d)(out)))
+        out = norm(train, dtype=d)(conv1x1(2 * group_width, dtype=d)(out))
+        if self.stride != 1 or x.shape[-1] != 2 * group_width:
+            x = norm(train, dtype=d)(
+                conv1x1(2 * group_width, stride=self.stride, dtype=d)(x))
+        return nn.relu(out + x)
+
+
+class ResNeXt(nn.Module):
+    num_blocks: tuple
+    cardinality: int
+    bottleneck_width: int
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        x = nn.relu(norm(train, dtype=d)(conv(64, 1, 1, padding=0, dtype=d)(x)))
+        width = self.bottleneck_width
+        for stage, nb in enumerate(self.num_blocks):
+            for i in range(nb):
+                stride = 2 if stage > 0 and i == 0 else 1
+                x = ResNeXtBlock(self.cardinality, width, stride, dtype=d)(x, train)
+            width *= 2
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=d)(x)
+
+
+def ResNeXt29_2x64d(num_classes=10, dtype=jnp.float32):
+    return ResNeXt((3, 3, 3), 2, 64, num_classes, dtype)
+
+
+def ResNeXt29_4x64d(num_classes=10, dtype=jnp.float32):
+    return ResNeXt((3, 3, 3), 4, 64, num_classes, dtype)
+
+
+def ResNeXt29_8x64d(num_classes=10, dtype=jnp.float32):
+    return ResNeXt((3, 3, 3), 8, 64, num_classes, dtype)
+
+
+def ResNeXt29_32x4d(num_classes=10, dtype=jnp.float32):
+    return ResNeXt((3, 3, 3), 32, 4, num_classes, dtype)

@@ -1,0 +1,51 @@
+"""SENet (counterpart of garfieldpp/models/senet.py): pre-activation basic
+blocks with squeeze-and-excitation gating."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, conv1x1, global_avg_pool, norm
+
+
+class SEBlock(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        out = nn.relu(norm(train, dtype=d)(x))
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.features:
+            shortcut = conv1x1(self.features, stride=self.stride, dtype=d)(out)
+        out = conv(self.features, 3, self.stride, padding=1, dtype=d)(out)
+        out = conv(self.features, 3, 1, padding=1, dtype=d)(
+            nn.relu(norm(train, dtype=d)(out)))
+        # Squeeze-and-excitation: global pool -> fc/16 -> fc -> sigmoid gate.
+        w = global_avg_pool(out)
+        w = nn.relu(nn.Dense(self.features // 16, dtype=d)(w))
+        w = nn.sigmoid(nn.Dense(self.features, dtype=d)(w))
+        out = out * w[:, None, None, :]
+        return out + shortcut
+
+
+class SENet(nn.Module):
+    num_blocks: tuple = (2, 2, 2, 2)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        x = nn.relu(norm(train, dtype=d)(conv(64, 3, 1, padding=1, dtype=d)(x)))
+        for stage, nb in enumerate(self.num_blocks):
+            for i in range(nb):
+                stride = 2 if stage > 0 and i == 0 else 1
+                x = SEBlock(64 * 2 ** stage, stride, dtype=d)(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=d)(x)
+
+
+def SENet18(num_classes=10, dtype=jnp.float32):
+    return SENet((2, 2, 2, 2), num_classes, dtype)

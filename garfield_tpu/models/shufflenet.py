@@ -1,0 +1,72 @@
+"""ShuffleNet v1 (counterpart of garfieldpp/models/shufflenet.py): grouped
+1x1 convs + channel shuffle."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import avg_pool, conv, conv1x1, global_avg_pool, norm
+
+
+def channel_shuffle(x, groups):
+    n, h, w, c = x.shape
+    return (x.reshape(n, h, w, groups, c // groups)
+             .transpose(0, 1, 2, 4, 3)
+             .reshape(n, h, w, c))
+
+
+class ShuffleBlock(nn.Module):
+    out_planes: int
+    stride: int
+    groups: int
+    first_group_conv: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        in_planes = x.shape[-1]
+        cat = self.stride == 2
+        mid = self.out_planes // 4
+        out_planes = self.out_planes - in_planes if cat else self.out_planes
+        g = self.groups if self.first_group_conv else 1
+        out = nn.relu(norm(train, dtype=d)(
+            conv1x1(mid, groups=g, dtype=d)(x)))
+        out = channel_shuffle(out, self.groups)
+        out = norm(train, dtype=d)(
+            conv(mid, 3, self.stride, padding=1, groups=mid, dtype=d)(out))
+        out = norm(train, dtype=d)(
+            conv1x1(out_planes, groups=self.groups, dtype=d)(out))
+        if cat:
+            res = avg_pool(x, 2)
+            return nn.relu(jnp.concatenate([out, res], axis=-1))
+        return nn.relu(out + x)
+
+
+class ShuffleNet(nn.Module):
+    out_planes: tuple
+    num_blocks: tuple
+    groups: int
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        x = nn.relu(norm(train, dtype=d)(conv1x1(24, dtype=d)(x)))
+        for stage in range(3):
+            for i in range(self.num_blocks[stage]):
+                stride = 2 if i == 0 else 1
+                x = ShuffleBlock(
+                    self.out_planes[stage], stride, self.groups,
+                    first_group_conv=not (stage == 0 and i == 0), dtype=d,
+                )(x, train)
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=d)(x)
+
+
+def ShuffleNetG2(num_classes=10, dtype=jnp.float32):
+    return ShuffleNet((200, 400, 800), (4, 8, 4), 2, num_classes, dtype)
+
+
+def ShuffleNetG3(num_classes=10, dtype=jnp.float32):
+    return ShuffleNet((240, 480, 960), (4, 8, 4), 3, num_classes, dtype)

@@ -1,0 +1,76 @@
+"""ShuffleNetV2 (counterpart of garfieldpp/models/shufflenetv2.py)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, conv1x1, global_avg_pool, norm
+
+configs = {
+    0.5: {"out_planes": (48, 96, 192), "num_blocks": (3, 7, 3)},
+    1.0: {"out_planes": (116, 232, 464), "num_blocks": (3, 7, 3)},
+    1.5: {"out_planes": (176, 352, 704), "num_blocks": (3, 7, 3)},
+    2.0: {"out_planes": (224, 488, 976), "num_blocks": (3, 7, 3)},
+}
+
+
+def channel_shuffle(x, groups=2):
+    n, h, w, c = x.shape
+    return (x.reshape(n, h, w, groups, c // groups)
+             .transpose(0, 1, 2, 4, 3)
+             .reshape(n, h, w, c))
+
+
+class BasicUnit(nn.Module):
+    out_planes: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        c = x.shape[-1] // 2
+        left, right = x[..., :c], x[..., c:]
+        mid = self.out_planes // 2
+        out = nn.relu(norm(train, dtype=d)(conv1x1(mid, dtype=d)(right)))
+        out = norm(train, dtype=d)(
+            conv(mid, 3, 1, padding=1, groups=mid, dtype=d)(out))
+        out = nn.relu(norm(train, dtype=d)(conv1x1(mid, dtype=d)(out)))
+        return channel_shuffle(jnp.concatenate([left, out], axis=-1))
+
+
+class DownUnit(nn.Module):
+    out_planes: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        mid = self.out_planes // 2
+        # left branch: depthwise stride-2 + 1x1
+        left = norm(train, dtype=d)(
+            conv(x.shape[-1], 3, 2, padding=1, groups=x.shape[-1], dtype=d)(x))
+        left = nn.relu(norm(train, dtype=d)(conv1x1(mid, dtype=d)(left)))
+        # right branch: 1x1 + depthwise stride-2 + 1x1
+        right = nn.relu(norm(train, dtype=d)(conv1x1(mid, dtype=d)(x)))
+        right = norm(train, dtype=d)(
+            conv(mid, 3, 2, padding=1, groups=mid, dtype=d)(right))
+        right = nn.relu(norm(train, dtype=d)(conv1x1(mid, dtype=d)(right)))
+        return channel_shuffle(jnp.concatenate([left, right], axis=-1))
+
+
+class ShuffleNetV2(nn.Module):
+    net_size: float = 1.0
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        d = self.dtype
+        cfg = configs[self.net_size]
+        x = nn.relu(norm(train, dtype=d)(conv(24, 3, 1, padding=1, dtype=d)(x)))
+        for stage in range(3):
+            x = DownUnit(cfg["out_planes"][stage], dtype=d)(x, train)
+            for _ in range(cfg["num_blocks"][stage]):
+                x = BasicUnit(cfg["out_planes"][stage], dtype=d)(x, train)
+        x = nn.relu(norm(train, dtype=d)(conv1x1(1024, dtype=d)(x)))
+        x = global_avg_pool(x)
+        return nn.Dense(self.num_classes, dtype=d)(x)

@@ -1,0 +1,50 @@
+"""VGG family (counterpart of garfieldpp/models/vgg.py; the reference also
+pulls vgg16/vgg19 from torchvision, garfieldpp/tools.py:74-75). CIFAR-style:
+conv+BN+ReLU stacks from the cfg table, 512-dim linear head."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ._layers import conv, max_pool, norm
+
+cfg = {
+    "VGG11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "VGG13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "VGG16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"],
+    "VGG19": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Module):
+    name_cfg: str = "VGG16"
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        for v in cfg[self.name_cfg]:
+            if v == "M":
+                x = max_pool(x, 2)
+            else:
+                x = nn.relu(norm(train, dtype=self.dtype)(
+                    conv(v, 3, 1, padding=1, dtype=self.dtype)(x)))
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def VGG11(num_classes=10, dtype=jnp.float32):
+    return VGG("VGG11", num_classes, dtype)
+
+
+def VGG13(num_classes=10, dtype=jnp.float32):
+    return VGG("VGG13", num_classes, dtype)
+
+
+def VGG16(num_classes=10, dtype=jnp.float32):
+    return VGG("VGG16", num_classes, dtype)
+
+
+def VGG19(num_classes=10, dtype=jnp.float32):
+    return VGG("VGG19", num_classes, dtype)

@@ -1,12 +1,20 @@
 """Test configuration: force a virtual 8-device CPU platform.
 
 This is the fake-backend the reference lacked (SURVEY §4): every distributed
-construct is testable single-process by running the SPMD program over
-XLA_FLAGS=--xla_force_host_platform_device_count=8. Must be set before jax
-is imported anywhere in the test process.
+construct is testable single-process by running the SPMD program over 8
+host-local CPU devices.
+
+Two paths, because jax may already be preloaded (and a TPU PJRT plugin
+registered) by the interpreter's sitecustomize before this file runs:
+  - if jax is not yet imported, plain env vars do the job;
+  - if it is, ``jax.config.update`` still wins as long as no backend has been
+    initialized — it both overrides the platform choice and sets the virtual
+    CPU device count, and keeps the TPU plugin from ever being initialized
+    (its init can block on an unavailable device tunnel).
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -14,3 +22,9 @@ if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)

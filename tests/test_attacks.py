@@ -1,0 +1,148 @@
+"""Tests for garfield_tpu.attacks — parity with byzWorker.py / byzServer.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from garfield_tpu import attacks
+from garfield_tpu.aggregators import gars
+
+
+def _stack(n=8, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+
+
+def _mask(n=8, byz=(0, 3)):
+    m = np.zeros(n, dtype=bool)
+    m[list(byz)] = True
+    return jnp.asarray(m)
+
+
+class TestGradientAttacks:
+    def test_honest_rows_untouched(self):
+        g, m = _stack(), _mask()
+        key = jax.random.PRNGKey(0)
+        for name in attacks.gradient_attacks:
+            out = attacks.apply_gradient_attack(name, g, m, key=key)
+            np.testing.assert_array_equal(
+                np.asarray(out)[~np.asarray(m)], np.asarray(g)[~np.asarray(m)],
+                err_msg=f"attack {name} modified honest rows",
+            )
+
+    def test_none_passthrough(self):
+        g, m = _stack(), _mask()
+        for name in (None, "none"):
+            out = attacks.apply_gradient_attack(name, g, m)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(g))
+
+    def test_unknown_attack_raises(self):
+        g, m = _stack(), _mask()
+        with pytest.raises(ValueError):
+            attacks.apply_gradient_attack("nope", g, m)
+
+    def test_random_needs_key(self):
+        g, m = _stack(), _mask()
+        with pytest.raises(ValueError):
+            attacks.apply_gradient_attack("random", g, m)
+
+    def test_reverse_is_times_minus_100(self):
+        """byzWorker.py:94 — grad * -100."""
+        g, m = _stack(), _mask()
+        out = attacks.apply_gradient_attack("reverse", g, m)
+        np.testing.assert_allclose(
+            np.asarray(out)[0], np.asarray(g)[0] * -100.0, rtol=1e-6
+        )
+
+    def test_drop_zeroes_about_p_fraction(self):
+        """byzWorker.py:103-105 — ~30% of coords zeroed on byz rows only."""
+        g = jnp.ones((4, 10000), dtype=jnp.float32)
+        m = jnp.asarray([True, False, True, False])
+        out = np.asarray(
+            attacks.apply_gradient_attack("drop", g, m, key=jax.random.PRNGKey(1))
+        )
+        frac0 = (out[0] == 0).mean()
+        assert 0.25 < frac0 < 0.35
+        assert (out[1] == 1).all()
+
+    def test_lie_matches_reference_formula(self):
+        """byzWorker.py:119-124 — mu + 1.035*sigma over cohort honest grads,
+        with torch's unbiased std."""
+        g, m = _stack(n=8), _mask(byz=(1, 4, 6))
+        out = np.asarray(attacks.apply_gradient_attack("lie", g, m))
+        cohort = np.asarray(g)[[1, 4, 6]]
+        expect = cohort.mean(0) + 1.035 * cohort.std(0, ddof=1)
+        for r in (1, 4, 6):
+            np.testing.assert_allclose(out[r], expect, rtol=1e-5)
+
+    def test_empire_matches_reference_formula(self):
+        """byzWorker.py:140-142 — -10 * mu over cohort honest grads."""
+        g, m = _stack(n=8), _mask(byz=(2, 5))
+        out = np.asarray(attacks.apply_gradient_attack("empire", g, m))
+        cohort = np.asarray(g)[[2, 5]]
+        np.testing.assert_allclose(out[2], -10.0 * cohort.mean(0), rtol=1e-5)
+
+    def test_lie_single_byzantine_nan_like_torch(self):
+        """fw=1: torch.std of one sample is NaN (byzWorker.py:121); GARs must
+        then treat the row as infinitely distant, not crash."""
+        g, m = _stack(n=6), _mask(n=6, byz=(3,))
+        out = attacks.apply_gradient_attack("lie", g, m)
+        assert np.isnan(np.asarray(out)[3]).all()
+        agg = gars["median"](out, f=1)
+        assert np.isfinite(np.asarray(agg)).all()
+
+    def test_attacks_jit_and_vmap_compatible(self):
+        g, m = _stack(), _mask()
+        key = jax.random.PRNGKey(2)
+
+        @jax.jit
+        def step(g, m, key):
+            return attacks.apply_gradient_attack("lie", g, m, key=key)
+
+        out = step(g, m, key)
+        assert out.shape == g.shape
+
+    def test_krum_resists_reverse(self):
+        """Integration: Multi-Krum must not select a reversed gradient when
+        n >= 2f+3 (the Byzantine-resilience contract the attacks exercise)."""
+        n, f = 11, 2
+        rng = np.random.default_rng(7)
+        base = rng.normal(size=(16,)).astype(np.float32)
+        g = jnp.asarray(base[None, :] + 0.01 * rng.normal(size=(n, 16)).astype(np.float32))
+        m = _mask(n=n, byz=(0, 1))
+        poisoned = attacks.apply_gradient_attack("reverse", g, m)
+        agg = np.asarray(gars["krum"](poisoned, f=f))
+        honest_mean = np.asarray(g)[2:].mean(0)
+        assert np.linalg.norm(agg - honest_mean) < 1.0
+        assert np.dot(agg, base) > 0  # not reversed
+
+
+class TestModelAttacks:
+    def test_reverse(self):
+        m = jnp.arange(8.0)
+        out = attacks.apply_model_attack("reverse", m)
+        np.testing.assert_allclose(np.asarray(out), np.arange(8.0) * -100.0)
+
+    def test_random_shape_and_range(self):
+        m = jnp.zeros(100)
+        out = np.asarray(
+            attacks.apply_model_attack("random", m, key=jax.random.PRNGKey(3))
+        )
+        assert out.shape == (100,)
+        assert (out >= 0).all() and (out < 1).all()
+
+    def test_drop_fraction(self):
+        m = jnp.ones(10000)
+        out = np.asarray(
+            attacks.apply_model_attack("drop", m, key=jax.random.PRNGKey(4))
+        )
+        assert 0.25 < (out == 0).mean() < 0.35
+
+    def test_passthrough_and_unknown(self):
+        m = jnp.ones(4)
+        np.testing.assert_array_equal(
+            np.asarray(attacks.apply_model_attack(None, m)), np.ones(4)
+        )
+        with pytest.raises(ValueError):
+            attacks.apply_model_attack("bogus", m)

@@ -1,0 +1,106 @@
+"""Tests for garfield_tpu.data — partitioner parity and manager semantics."""
+
+import numpy as np
+import pytest
+
+from garfield_tpu import data
+
+
+class TestDataPartitioner:
+    def test_reference_partition_scheme(self):
+        """Bit-compatibility with datasets.py:121-150: same rng stream, same
+        slicing (first int(frac*n) of the remaining indices, shuffled)."""
+        from random import Random
+
+        n, sizes, seed = 100, [0.25, 0.25, 0.25, 0.25], 1234
+        part = data.DataPartitioner(n, sizes, seed)
+        rng = Random()
+        rng.seed(seed)
+        indexes = list(range(n))
+        for k, frac in enumerate(sizes):
+            plen = int(frac * n)
+            tmp = indexes[0:plen]
+            rng.shuffle(tmp)
+            assert list(part.use(k)) == tmp
+            indexes = indexes[plen:]
+
+    def test_partitions_disjoint_and_cover(self):
+        part = data.DataPartitioner(1000, [0.5, 0.3, 0.2])
+        all_idx = np.concatenate([part.use(i) for i in range(3)])
+        assert len(all_idx) == 1000
+        assert len(set(all_idx.tolist())) == 1000
+
+    def test_deterministic(self):
+        a = data.DataPartitioner(64, [0.5, 0.5]).use(0)
+        b = data.DataPartitioner(64, [0.5, 0.5]).use(0)
+        np.testing.assert_array_equal(a, b)
+
+
+class TestDatasetManager:
+    def test_worker_partitions_disjoint(self):
+        m1 = data.DatasetManager("mnist", 8, num_workers=4, size=5, rank=1)
+        m2 = data.DatasetManager("mnist", 8, num_workers=4, size=5, rank=2)
+        x1, _ = m1.get_train_set()
+        x2, _ = m2.get_train_set()
+        assert x1.shape == x2.shape
+        assert not np.array_equal(x1[0], x2[0])
+
+    def test_batch_shapes(self):
+        m = data.DatasetManager("mnist", 8, num_workers=4, size=5, rank=1)
+        xb, yb = m.get_train_set()
+        assert xb.shape[1:] == (8, 28, 28, 1)
+        assert yb.shape[1] == 8
+        test_batches = m.get_test_set()
+        assert test_batches[0][0].shape == (100, 28, 28, 1)
+
+    def test_sharded_train_batches(self):
+        m = data.DatasetManager("mnist", 4, num_workers=4, size=4, rank=0)
+        # size == num_workers => num_ps == 0, every rank is a worker
+        xs, ys = m.sharded_train_batches()
+        assert xs.shape[0] == 4 and xs.shape[2] == 4
+        assert ys.shape[:2] == xs.shape[:2]
+        # worker streams must differ (disjoint partitions)
+        assert not np.array_equal(xs[0], xs[1])
+
+    def test_pima_shapes(self):
+        m = data.DatasetManager("pima", 16, num_workers=2, size=3, rank=1)
+        xb, yb = m.get_train_set()
+        assert xb.shape[2] == 8  # 8 diagnostic features
+        assert yb.shape[2] == 1  # binary target column
+        assert yb.dtype == np.float32
+
+    def test_pima_test_set_keeps_ragged_tail(self):
+        """All 168 pima test samples must be served (datasets.py:245-250
+        keeps the final partial batch; dropping it loses 68 samples)."""
+        m = data.DatasetManager("pima", 16, num_workers=2, size=3, rank=1)
+        batches = m.get_test_set(batch=100)
+        assert sum(len(x) for x, _ in batches) == 168
+        assert [len(x) for x, _ in batches] == [100, 68]
+
+    def test_cifar_shapes(self):
+        m = data.DatasetManager("cifar10", 4, num_workers=2, size=2, rank=0)
+        xb, yb = m.get_train_set()
+        assert xb.shape[2:] == (4, 32, 32, 3)[1:]
+
+    def test_unknown_dataset_raises(self):
+        with pytest.raises(ValueError):
+            data.DatasetManager("svhn", 4, 2, 2, 0)
+
+    def test_synthetic_determinism(self):
+        (a, _), _ = data.load_dataset("mnist")
+        (b, _), _ = data.load_dataset("mnist")
+        np.testing.assert_array_equal(a[:10], b[:10])
+
+    def test_synthetic_learnable_structure(self):
+        """Class-conditional means: nearest-centroid on train centroids must
+        beat chance on test — the property convergence tests rely on."""
+        (tx, ty), (vx, vy) = data.load_dataset("mnist")
+        tx = tx.reshape(len(tx), -1)[:5000]
+        ty = ty[:5000]
+        vx = vx.reshape(len(vx), -1)[:1000]
+        vy = vy[:1000]
+        cents = np.stack([tx[ty == c].mean(0) for c in range(10)])
+        pred = np.argmin(
+            ((vx[:, None, :] - cents[None, :, :]) ** 2).sum(-1), axis=1
+        )
+        assert (pred == vy).mean() > 0.5
