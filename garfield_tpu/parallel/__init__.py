@@ -1,0 +1,68 @@
+"""SPMD parallel training core: mesh, roles-as-functions, and the three
+Byzantine-resilient topologies of the reference (SURVEY §2.3):
+
+  - ``aggregathor`` — single trusted PS, n workers (SSMW;
+    pytorch_impl/applications/Aggregathor/); ``granularity="layer"`` gives
+    the Garfield_CC per-parameter collective semantics; num_workers=1, f=0
+    degenerates to the Centralized baseline.
+  - ``byzsgd``      — replicated Byzantine PS (MSMW / GuanYu;
+    pytorch_impl/applications/ByzSGD/).
+  - ``learn``       — fully decentralized gossip (LEARN;
+    pytorch_impl/applications/LEARN/).
+
+Each exposes ``make_trainer(...) -> (init_fn, step_fn, eval_fn)`` with
+``step_fn`` one jit'd SPMD program over the ICI mesh — the reference's
+RPC / NCCL / gRPC round trips (SURVEY §2.3 comm-backend row) appear only as
+XLA all_gather/psum collectives inside it.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import aggregathor, byzsgd, core, learn, mesh
+from .core import TrainState, default_byz_mask, make_worker_fns
+from .mesh import make_mesh
+
+__all__ = [
+    "aggregathor",
+    "byzsgd",
+    "learn",
+    "core",
+    "mesh",
+    "TrainState",
+    "default_byz_mask",
+    "make_worker_fns",
+    "make_mesh",
+    "topologies",
+    "compute_accuracy",
+]
+
+topologies = {
+    "centralized": aggregathor,  # num_workers=1, f=0 (P16)
+    "aggregathor": aggregathor,  # P17
+    "byzsgd": byzsgd,  # P18
+    "learn": learn,  # P19
+    "garfield_cc": aggregathor,  # P20 — granularity="layer"
+}
+
+
+def compute_accuracy(state, eval_fn, test_batches, *, binary=False):
+    """Top-1 accuracy over a list of (x, y) test batches.
+
+    Counterpart of ``Server.compute_accuracy`` (server.py:235-254) / the TF
+    ``compute_accuracy`` (tensorflow_impl/libs/server.py:152-163). ``binary``
+    follows the pima path (single sigmoid logit, byzWorker-era threshold 0.5).
+    """
+    correct = total = 0
+    for x, y in test_batches:
+        logits = np.asarray(eval_fn(state, jnp.asarray(x)))
+        y = np.asarray(y)
+        if binary:
+            # pima path: sigmoid output, threshold 0.5 (demo.py accuracy).
+            pred = (logits.reshape(-1) > 0.5).astype(y.dtype)
+            correct += int((pred == y.reshape(-1)).sum())
+        else:
+            correct += int((logits.argmax(-1) == y.reshape(-1)).sum())
+        total += len(y)
+    return correct / max(total, 1)

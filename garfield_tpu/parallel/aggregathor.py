@@ -1,0 +1,221 @@
+"""AggregaThor topology: single trusted server, n workers, f Byzantine.
+
+TPU-native re-design of ``pytorch_impl/applications/Aggregathor/trainer.py``
+(train step :231-249) and the Server/Worker RPC machinery it drives
+(server.py:112-159, worker.py:77-96). Per SURVEY §7, the whole PS round trip
+collapses into one jit'd SPMD program over a "workers" mesh axis:
+
+    grads  = vmap(worker_grad)(params, local_batches)     # worker.py:77-96
+    stack  = lax.all_gather(grads, "workers")             # server.py:112-159
+    stack  = attack(stack, byz_mask)                      # byzWorker.py:78-143
+    stack  = stack[subset]                                # wait n-f, :134-155
+    aggr   = gar(stack, f)                                # trainer.py:236
+    params = optimizer(params, aggr)                      # server.py:277-287
+
+The aggregation and update run redundantly on every shard (replicated
+output), so there is no broadcast step: SPMD replication replaces
+``write_model`` (server.py:289-297).
+
+``granularity="layer"`` reproduces the Garfield_CC semantics of applying the
+GAR per parameter tensor (Garfield_CC/trainer.py:55-204 loops over
+``model.parameters()``) instead of over the whole flat gradient.
+
+Centralized (pytorch_impl/applications/Centralized/trainer.py) is this
+topology with num_workers=1, f=0, gar="average", attack=None.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import aggregators
+from ..attacks import apply_gradient_attack, gradient_attacks
+from . import core, mesh as mesh_lib
+
+__all__ = ["make_trainer"]
+
+
+def _resolve_gar(gar):
+    if isinstance(gar, str):
+        return aggregators.gars[gar]
+    return gar
+
+
+def _check_gar(gar, n_effective, f, d=2):
+    """Run the rule's contract check once at build time (the reference checks
+    on every call under __debug__, aggregators/__init__.py:53-61; here n and f
+    are static so once suffices)."""
+    import numpy as np
+
+    dummy = np.zeros((n_effective, d), dtype=np.float32)
+    message = gar.check(dummy, f=f)
+    if message is not None:
+        raise AssertionError(
+            f"aggregation rule {gar.name!r} cannot be used: {message}"
+        )
+
+
+def _attack_then_aggregate(
+    flat_stack, byz_mask, atk_key, sub_key, *, attack, attack_params, gar,
+    f, subset,
+):
+    """Poison rows, optionally subsample (wait n-f), aggregate. Pure."""
+    n = flat_stack.shape[0]
+    stack = apply_gradient_attack(
+        attack, flat_stack, byz_mask, key=atk_key, **attack_params
+    )
+    if subset is not None and subset < n:
+        sel = core.subset_indices(sub_key, n, subset)
+        stack = stack[sel]
+    return gar.unchecked(stack, f=f)
+
+
+def make_trainer(
+    module,
+    loss_fn,
+    optimizer,
+    gar,
+    *,
+    num_workers,
+    f=0,
+    attack=None,
+    attack_params=None,
+    byz_mask=None,
+    mesh=None,
+    axis="workers",
+    subset=None,
+    granularity="model",
+):
+    """Build ``(init_fn, step_fn, eval_fn)`` for the SSMW topology.
+
+    Args mirror the reference CLI (Aggregathor/trainer.py:62-135): ``f`` is
+    the declared tolerance passed to the GAR; ``attack``/``byz_mask`` control
+    actual fault injection (byzWorker.py); ``subset=q`` emulates the
+    asynchronous wait-for-q path (server.py:134-155); ``granularity`` picks
+    whole-model (trainer.py:236) vs per-layer (Garfield_CC) aggregation.
+
+    ``step_fn(state, x, y) -> (state, metrics)`` expects ``x``/``y`` with a
+    leading ``num_workers`` axis, sharded over ``axis``; it is jit'd with
+    replicated state output, so calling it in a loop keeps everything
+    on-device.
+    """
+    gar = _resolve_gar(gar)
+    attack_params = dict(attack_params or {})
+    if mesh is None:
+        mesh = mesh_lib.make_mesh({axis: -1})
+    if subset is not None and not (1 <= subset <= num_workers):
+        raise ValueError(
+            f"subset (wait-for-q) must be in [1, num_workers], got {subset}"
+        )
+    n_eff = subset if subset is not None else num_workers
+    _check_gar(gar, n_eff, f)
+    axis_size = mesh.shape[axis]
+    per_shard = mesh_lib.fold(num_workers, axis_size, "workers")
+    if attack is not None and attack != "none" and attack not in gradient_attacks:
+        raise ValueError(f"unknown attack {attack!r}")
+    if byz_mask is None:
+        byz_mask = core.default_byz_mask(num_workers, f if attack else 0)
+    byz_mask = jnp.asarray(byz_mask, dtype=bool)
+
+    init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
+    repl = NamedSharding(mesh, P())
+    shard_w = NamedSharding(mesh, P(axis))
+
+    def init_fn(key, example_x, seed_rng=None):
+        params, model_state = init_worker(key, example_x)
+        opt_state = optimizer.init(params)
+        state = core.TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            model_state=model_state,
+            opt_state=opt_state,
+            rng=key if seed_rng is None else seed_rng,
+        )
+        return jax.device_put(state, repl)
+
+    def _local_step(state, x_local, y_local):
+        """Body run per shard under shard_map."""
+        params, ms = state.params, state.model_state
+        base = jax.random.fold_in(state.rng, state.step)
+        atk_key, sub_key, drop_base = jax.random.split(base, 3)
+        shard_idx = jax.lax.axis_index(axis)
+        slot_ids = shard_idx * per_shard + jnp.arange(per_shard)
+        drop_keys = jax.vmap(lambda i: jax.random.fold_in(drop_base, i))(slot_ids)
+
+        grads_local, (loss_local, ms_local) = jax.vmap(
+            grad_fn, in_axes=(None, None, 0, 0, 0)
+        )(params, ms, x_local, y_local, drop_keys)
+
+        # all_gather over the mesh axis == Server.get_gradients (RPC gather).
+        grads = jax.tree.map(
+            lambda l: jax.lax.all_gather(l, axis, tiled=True), grads_local
+        )
+        losses = jax.lax.all_gather(loss_local, axis, tiled=True)
+        new_ms = core.mean_model_state(ms_local, axis)
+
+        honest = (~byz_mask).astype(losses.dtype)
+        mean_loss = jnp.sum(losses * honest) / jnp.sum(honest)
+
+        agg_kwargs = dict(
+            attack=attack, attack_params=attack_params, gar=gar, f=f,
+            subset=subset,
+        )
+        if granularity == "layer":
+            # Garfield_CC per-parameter aggregation: independent GAR (and
+            # attack statistics) per tensor, like the reference's per-layer
+            # gather->GAR loop (Garfield_CC/trainer.py:91-127).
+            leaves, treedef = jax.tree.flatten(grads)
+            out_leaves = []
+            for i, leaf in enumerate(leaves):
+                n = leaf.shape[0]
+                flat = leaf.reshape(n, -1)
+                akey = jax.random.fold_in(atk_key, i)
+                aggr = _attack_then_aggregate(
+                    flat, byz_mask, akey, sub_key, **agg_kwargs
+                )
+                out_leaves.append(aggr.reshape(leaf.shape[1:]))
+            aggr_tree = jax.tree.unflatten(treedef, out_leaves)
+        else:
+            flat_stack = core.flatten_rows(grads)
+            aggr = _attack_then_aggregate(
+                flat_stack, byz_mask, atk_key, sub_key, **agg_kwargs
+            )
+            aggr_tree = core.unflatten_like(params, aggr)
+
+        updates, new_opt = optimizer.update(aggr_tree, state.opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            model_state=new_ms,
+            opt_state=new_opt,
+        )
+        return new_state, {"loss": mean_loss}
+
+    sharded_step = jax.shard_map(
+        _local_step,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    @functools.partial(
+        jax.jit,
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+    def step_fn(state, x, y):
+        return sharded_step(state, x, y)
+
+    @jax.jit
+    def eval_fn(state, x):
+        return eval_apply(state.params, state.model_state, x)
+
+    step_fn.mesh = mesh
+    step_fn.batch_sharding = shard_w
+    return init_fn, step_fn, eval_fn

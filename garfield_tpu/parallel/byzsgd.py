@@ -1,0 +1,268 @@
+"""ByzSGD / GuanYu topology: replicated Byzantine parameter servers.
+
+TPU-native re-design of ``pytorch_impl/applications/ByzSGD/trainer.py``:
+each of ``num_ps`` servers runs the AggregaThor step on the shared worker
+gradients, then a model-space "gather step" (trainer.py:240-244) pulls every
+peer server's model, GAR-aggregates them, and writes the result back —
+defending against Byzantine servers (byzServer.py) exactly as the gradient
+GAR defends against Byzantine workers.
+
+SPMD mapping (SURVEY §2.3 "Replicated-PS" row): a 2-D mesh ("ps", axis);
+server state is stacked over the "ps" axis, worker batches are sharded over
+``axis``. Per step, on the device at (i, j):
+
+    grads[j]    = vmap(worker_grad)(params[i], batch[j])   # each PS pushes its
+                                                           # own model, server.py:112
+    stack       = all_gather(grads, axis)                  # (n_w, d) per ps slot
+    stack       = attack(stack, byz_workers)               # byzWorker.py
+    aggr[i]     = gar(stack[subset_i], f_w)                # per-PS wait n-f subset
+    params[i]   = opt(params[i], aggr[i])                  # update_model
+    models      = all_gather(flat(params), "ps")           # get_models, :161-184
+    models      = model_attack(models, byz_ps)             # byzServer.py:86-108
+    params[i]   = unflat(gar(models, f_ps))                # write_model, :289-297
+
+Honest-PS divergence (the reason model aggregation exists at all) arises here
+from per-PS wait-n-f subsets — each PS samples its *own* q of n gradients,
+mirroring different arrival orders at different servers in the async
+reference.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import aggregators
+from ..attacks import apply_gradient_attack, apply_model_attack, model_attacks
+from . import core, mesh as mesh_lib
+from .aggregathor import _check_gar, _resolve_gar
+
+__all__ = ["make_trainer"]
+
+
+def make_trainer(
+    module,
+    loss_fn,
+    optimizer,
+    gar,
+    *,
+    num_workers,
+    num_ps,
+    fw=0,
+    fps=0,
+    attack=None,
+    attack_params=None,
+    ps_attack=None,
+    ps_attack_params=None,
+    byz_worker_mask=None,
+    byz_ps_mask=None,
+    mesh=None,
+    axis="workers",
+    ps_axis="ps",
+    subset=None,
+    model_gar=None,
+):
+    """Build ``(init_fn, step_fn, eval_fn)`` for the MSMW topology.
+
+    ``gar`` aggregates gradients with tolerance ``fw``; ``model_gar``
+    (default: same rule) aggregates server models with tolerance ``fps`` —
+    the reference uses one GAR for both (ByzSGD/trainer.py:34 note).
+    ``subset=q`` gives each PS its own sampled wait-for-q gradient subset.
+
+    ``step_fn(state, x, y)``: ``x``/``y`` lead with ``num_workers`` sharded
+    over ``axis``; state params/opt_state lead with ``num_ps`` sharded over
+    ``ps_axis``.
+    """
+    gar = _resolve_gar(gar)
+    model_gar = gar if model_gar is None else _resolve_gar(model_gar)
+    attack_params = dict(attack_params or {})
+    ps_attack_params = dict(ps_attack_params or {})
+    if mesh is None:
+        mesh = mesh_lib.make_mesh({ps_axis: 1, axis: -1})
+    if subset is not None and not (1 <= subset <= num_workers):
+        raise ValueError(
+            f"subset (wait-for-q) must be in [1, num_workers], got {subset}"
+        )
+    n_eff = subset if subset is not None else num_workers
+    _check_gar(gar, n_eff, fw)
+    per_w = mesh_lib.fold(num_workers, mesh.shape[axis], "workers")
+    per_ps = mesh_lib.fold(num_ps, mesh.shape[ps_axis], "servers")
+    if num_ps > 1 or fps:
+        _check_gar(model_gar, num_ps, fps)
+    if ps_attack is not None and ps_attack != "none" and ps_attack not in model_attacks:
+        raise ValueError(f"unknown model attack {ps_attack!r}")
+    if byz_worker_mask is None:
+        byz_worker_mask = core.default_byz_mask(num_workers, fw if attack else 0)
+    if byz_ps_mask is None:
+        byz_ps_mask = core.default_byz_mask(num_ps, fps if ps_attack else 0)
+    byz_worker_mask = jnp.asarray(byz_worker_mask, bool)
+    byz_ps_mask = jnp.asarray(byz_ps_mask, bool)
+
+    init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
+    repl = NamedSharding(mesh, P())
+    ps_sharding = NamedSharding(mesh, P(ps_axis))
+
+    def init_fn(key, example_x, seed_rng=None):
+        params, model_state = init_worker(key, example_x)
+        opt_state = optimizer.init(params)
+        # Stack server-resident state over the ps axis (identical replicas at
+        # t=0, like every server loading the same seeded model).
+        stack = lambda tree: jax.tree.map(
+            lambda l: jnp.broadcast_to(l[None], (num_ps,) + l.shape), tree
+        )
+        state = core.TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=jax.device_put(stack(params), ps_sharding),
+            model_state=jax.device_put(model_state, repl),
+            opt_state=jax.device_put(stack(opt_state), ps_sharding),
+            rng=jax.device_put(key if seed_rng is None else seed_rng, repl),
+        )
+        return state.replace(step=jax.device_put(state.step, repl))
+
+    def _ps_slot_step(ps_id, params, opt_state, grads_stack, keys):
+        """One server's gradient phase: attack is already applied; sample this
+        PS's own arrival subset, aggregate, update (server.py:112-159 +
+        update_model :277-287)."""
+        atk_unused, sub_key = keys
+        stack = grads_stack
+        n = stack.shape[0]
+        if subset is not None and subset < n:
+            sel = core.subset_indices(
+                jax.random.fold_in(sub_key, ps_id), n, subset
+            )
+            stack = stack[sel]
+        aggr = gar.unchecked(stack, f=fw)
+        updates, new_opt = optimizer.update(
+            core.unflatten_like(params, aggr), opt_state, params
+        )
+        return optax.apply_updates(params, updates), new_opt
+
+    def _local_step(state, x_local, y_local):
+        base = jax.random.fold_in(state.rng, state.step)
+        atk_key, sub_key, psatk_key, drop_base = jax.random.split(base, 4)
+        ps_shard = jax.lax.axis_index(ps_axis)
+        w_shard = jax.lax.axis_index(axis)
+        ps_ids = ps_shard * per_ps + jnp.arange(per_ps)
+        slot_ids = w_shard * per_w + jnp.arange(per_w)
+
+        # --- gradient phase, vmapped over this shard's local PS slots -----
+        def grads_for_ps(ps_local_idx, params, ms):
+            keys = jax.vmap(
+                lambda i: jax.random.fold_in(
+                    jax.random.fold_in(drop_base, ps_local_idx), i
+                )
+            )(slot_ids)
+            g, (loss, ms_out) = jax.vmap(
+                grad_fn, in_axes=(None, None, 0, 0, 0)
+            )(params, ms, x_local, y_local, keys)
+            flat = core.flatten_rows(g)  # (per_w, d)
+            stack = jax.lax.all_gather(flat, axis, tiled=True)  # (n_w, d)
+            return stack, loss, ms_out
+
+        # Unrolled over the (small, static) local PS slots: a vmap here would
+        # batch conv kernels over the ps axis, which XLA's conv batching
+        # rules handle poorly; per_ps is O(1) so unrolling is free.
+        ms = state.model_state
+        outs = [
+            grads_for_ps(
+                ps_ids[k],
+                jax.tree.map(lambda l: l[k], state.params),
+                ms,
+            )
+            for k in range(per_ps)
+        ]
+        stacks = jnp.stack([o[0] for o in outs])  # (per_ps, n_w, d)
+        losses = jnp.stack([o[1] for o in outs])  # (per_ps, per_w)
+        ms_all = jax.tree.map(
+            lambda *ls: jnp.stack(ls), *[o[2] for o in outs]
+        )
+
+        stacks = jax.vmap(
+            lambda s: apply_gradient_attack(
+                attack, s, byz_worker_mask, key=atk_key, **attack_params
+            )
+        )(stacks)
+
+        new_params, new_opt = jax.vmap(
+            _ps_slot_step, in_axes=(0, 0, 0, 0, None)
+        )(ps_ids, state.params, state.opt_state, stacks, (atk_key, sub_key))
+
+        # --- model gather phase (ByzSGD/trainer.py:240-244) ----------------
+        flat_models = core.flatten_rows(new_params)  # (per_ps, d)
+        models = jax.lax.all_gather(flat_models, ps_axis, tiled=True)  # (n_ps, d)
+        poisoned = jax.vmap(
+            lambda i, m: apply_model_attack(
+                ps_attack, m, key=jax.random.fold_in(psatk_key, i),
+                **ps_attack_params,
+            )
+        )(jnp.arange(num_ps), models)
+        models = jnp.where(byz_ps_mask[:, None], poisoned, models)
+        aggr_model = model_gar.unchecked(models, f=fps)
+        written = core.unflatten_like(
+            jax.tree.map(lambda l: l[0], new_params), aggr_model
+        )
+        new_params = jax.tree.map(
+            lambda l: jnp.broadcast_to(l[None], (per_ps,) + l.shape), written
+        )
+
+        # losses: (per_ps, per_w) — honest-worker mean, then over the mesh.
+        honest = (~byz_worker_mask).astype(losses.dtype)
+        local_honest = honest[slot_ids]
+        loss_num = jnp.sum(jnp.mean(losses, axis=0) * local_honest)
+        loss_den = jnp.sum(local_honest)
+        mean_loss = jax.lax.psum(loss_num, axis) / jnp.maximum(
+            jax.lax.psum(loss_den, axis), 1.0
+        )
+        mean_loss = jax.lax.pmean(mean_loss, ps_axis)
+
+        new_ms = core.mean_model_state(
+            jax.tree.map(lambda l: l.reshape((-1,) + l.shape[2:]), ms_all), axis
+        )
+        new_ms = jax.tree.map(lambda l: jax.lax.pmean(l, ps_axis), new_ms)
+
+        return (
+            state.replace(
+                step=state.step + 1,
+                params=new_params,
+                model_state=new_ms,
+                opt_state=new_opt,
+            ),
+            {"loss": mean_loss},
+        )
+
+    sharded_step = jax.shard_map(
+        _local_step,
+        mesh=mesh,
+        in_specs=(
+            core.TrainState(
+                step=P(), params=P(ps_axis), model_state=P(),
+                opt_state=P(ps_axis), rng=P(),
+            ),
+            P(axis),
+            P(axis),
+        ),
+        out_specs=(
+            core.TrainState(
+                step=P(), params=P(ps_axis), model_state=P(),
+                opt_state=P(ps_axis), rng=P(),
+            ),
+            P(),
+        ),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step_fn(state, x, y):
+        return sharded_step(state, x, y)
+
+    @jax.jit
+    def eval_fn(state, x):
+        params0 = jax.tree.map(lambda l: l[0], state.params)
+        return eval_apply(params0, state.model_state, x)
+
+    step_fn.mesh = mesh
+    step_fn.batch_sharding = NamedSharding(mesh, P(axis))
+    return init_fn, step_fn, eval_fn

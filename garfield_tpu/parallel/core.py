@@ -1,0 +1,147 @@
+"""Functional training core shared by all topologies.
+
+Counterpart of the reference's node-role machinery, re-designed for SPMD:
+
+  - ``make_worker_fns``  — the Worker role (pytorch_impl/libs/garfieldpp/
+    worker.py:50-96): forward + backward on a minibatch, gradients flattened
+    into one 1-D vector (worker.py:93-94). Here it is a pure function
+    ``(params, model_state, x, y, rng) -> (grads_tree, aux)`` built from a
+    flax module; topologies vmap it over logical worker slots and shard the
+    vmapped axis over the mesh.
+  - ``TrainState``       — the Server role's mutable state (server.py:56-99:
+    model, optimizer, iteration counter) as an immutable pytree; ``update``
+    applies a flat aggregated gradient exactly like ``Server.update_model``
+    (server.py:277-287 slices the flat vector back into per-param grads).
+  - ``flatten_rows`` / ``subset_indices`` / ``mean_model_state`` — stack
+    handling, wait-n-f emulation (server.py:118-119,134-155: proceed with the
+    fastest n-f responses; bulk-synchronous XLA has no stragglers, so the
+    sampled subset models *which* n-f arrived first), and cross-worker
+    BatchNorm-statistics averaging (a deliberate improvement: the reference
+    silently drops worker BN-buffer updates because only gradients travel
+    over RPC).
+"""
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+__all__ = [
+    "TrainState",
+    "make_worker_fns",
+    "flatten_rows",
+    "unflatten_like",
+    "subset_indices",
+    "mean_model_state",
+    "default_byz_mask",
+]
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Replicated (or ps/node-stacked) training state.
+
+    ``model_state`` holds flax mutable collections (``batch_stats``);
+    ``rng`` is the base PRNG key; per-step keys are derived by fold_in so a
+    run is replayable from (seed, step) alone — the reference relies on
+    ``torch.manual_seed(1234)`` + call order (Aggregathor/trainer.py:210-212).
+    """
+
+    step: jax.Array
+    params: dict
+    model_state: dict
+    opt_state: object
+    rng: jax.Array
+
+
+def make_worker_fns(module, loss_fn):
+    """Build the pure Worker functions for a flax module.
+
+    Returns ``(init_fn, grad_fn, eval_fn)``:
+      - ``init_fn(key, example_x) -> (params, model_state)``
+      - ``grad_fn(params, model_state, x, y, rng) -> (grads, (loss, new_ms))``
+        where ``grads`` is a pytree shaped like params (flattening is the
+        topology's job — per-layer GARs need the tree);
+      - ``eval_fn(params, model_state, x) -> logits`` (train=False), used by
+        ``compute_accuracy`` (server.py:235-254).
+    """
+
+    def init_fn(key, example_x):
+        pkey, dkey = jax.random.split(key)
+        variables = module.init(
+            {"params": pkey, "dropout": dkey}, example_x, train=False
+        )
+        variables = dict(variables)
+        params = variables.pop("params")
+        return params, variables
+
+    def loss_of(params, model_state, x, y, rng):
+        out = module.apply(
+            {"params": params, **model_state},
+            x,
+            train=True,
+            mutable=list(model_state.keys()),
+            rngs={"dropout": rng},
+        )
+        logits, new_ms = out
+        return loss_fn(logits, y), new_ms
+
+    def grad_fn(params, model_state, x, y, rng):
+        (loss, new_ms), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            params, model_state, x, y, rng
+        )
+        return grads, (loss, new_ms)
+
+    def eval_fn(params, model_state, x):
+        return module.apply({"params": params, **model_state}, x, train=False)
+
+    return init_fn, grad_fn, eval_fn
+
+
+def flatten_rows(stacked_tree):
+    """(n, ...) stacked gradient pytree -> (n, d) matrix of flat rows.
+
+    Equivalent of the reference's per-worker ``torch.cat([g.view(-1)])``
+    (worker.py:93-94) applied to every row of the gathered stack.
+    """
+    return jax.vmap(lambda row: ravel_pytree(row)[0])(stacked_tree)
+
+
+def unflatten_like(template_tree, flat_vec):
+    """Inverse of ``ravel_pytree``: slice a flat vector into a params-shaped
+    pytree (Server.update_model's slicing loop, server.py:277-287)."""
+    _, unravel = ravel_pytree(template_tree)
+    return unravel(flat_vec)
+
+
+def subset_indices(key, n, q):
+    """Uniformly sample q of n row indices (static shape (q,)).
+
+    Emulates the wait-fastest-n-f path (server.py:134-155): the reference
+    takes whichever q = n - f responses land first; arrival order on a real
+    async cluster is effectively random, so a seeded uniform sample is the
+    faithful bulk-synchronous stand-in (SURVEY §2.3 asynchrony row).
+    """
+    return jax.random.permutation(key, n)[:q]
+
+
+def mean_model_state(stacked_ms, axis_name=None):
+    """Average per-worker mutable collections (BatchNorm running stats) over
+    the local slot axis and, if ``axis_name`` is given, over that mesh axis.
+    """
+    ms = jax.tree.map(lambda l: jnp.mean(l, axis=0), stacked_ms)
+    if axis_name is not None:
+        ms = jax.tree.map(lambda l: jax.lax.pmean(l, axis_name), ms)
+    return ms
+
+
+def default_byz_mask(n, f):
+    """Boolean (n,) mask with the *last* f slots Byzantine, matching the
+    reference's rank layout (Aggregathor/trainer.py:217-268: Byzantine
+    workers are the highest ranks)."""
+    import numpy as np
+
+    mask = np.zeros(n, dtype=bool)
+    if f:
+        mask[n - f :] = True
+    return mask

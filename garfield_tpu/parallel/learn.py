@@ -1,0 +1,223 @@
+"""LEARN topology: fully decentralized Byzantine-resilient collaborative
+learning (every node is Worker + Server).
+
+TPU-native re-design of ``pytorch_impl/applications/LEARN/trainer.py``
+(node loop :224-257, ``avg_agree`` gossip :208-222): n peer nodes each hold
+their own model and data shard; per step each node
+
+    1. computes its own gradient                       (trainer.py:233-236)
+    2. gathers everyone's gradients and aggregates     (:237-241)
+    3. (non-iid) repeats ceil(log2 t) "agreement" rounds, re-gathering the
+       peers' *aggregated* gradients and re-aggregating (:208-222, :251-252)
+    4. applies its optimizer                            (:247-249)
+    5. gossips models: gathers peer models, GAR-aggregates, writes back
+                                                        (:255-257)
+
+SPMD mapping (SURVEY §2.3 "Decentralized P2P" row): one "nodes" mesh axis;
+model/optimizer state is stacked over it; every get_aggr_grads/get_models RPC
+poll (server.py:202-233) becomes one all_gather. Byzantine nodes inject
+gradient attacks (byzWorker.py) in phases 1-3 and model attacks
+(byzServer.py) in phase 5 — value transforms on their rows of the gathered
+stacks.
+
+The ceil(log2 t) round count is data-dependent on the step counter, so the
+gossip loop is a ``lax.fori_loop`` over a static ``max_rounds`` with rounds
+beyond the target masked to no-ops (XLA needs static trip structure).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..attacks import apply_gradient_attack, apply_model_attack
+from . import core, mesh as mesh_lib
+from .aggregathor import _check_gar, _resolve_gar
+
+__all__ = ["make_trainer"]
+
+
+def make_trainer(
+    module,
+    loss_fn,
+    optimizer,
+    gar,
+    *,
+    num_nodes,
+    f=0,
+    attack=None,
+    attack_params=None,
+    model_attack=None,
+    model_attack_params=None,
+    byz_mask=None,
+    mesh=None,
+    axis="nodes",
+    non_iid=False,
+    max_rounds=12,
+    model_gossip=True,
+):
+    """Build ``(init_fn, step_fn, eval_fn)`` for the LEARN topology.
+
+    ``non_iid=True`` enables the ceil(log2 t) agreement rounds
+    (LEARN/trainer.py:251-252 runs them only for non-iid data); ``max_rounds``
+    caps them (2^12 = 4096 steps of exact parity by default).
+    ``step_fn(state, x, y)``: leading ``num_nodes`` axis on x/y and on every
+    params/opt_state leaf, all sharded over ``axis``.
+    """
+    gar = _resolve_gar(gar)
+    attack_params = dict(attack_params or {})
+    model_attack_params = dict(model_attack_params or {})
+    if mesh is None:
+        mesh = mesh_lib.make_mesh({axis: -1})
+    per_n = mesh_lib.fold(num_nodes, mesh.shape[axis], "nodes")
+    _check_gar(gar, num_nodes, f)
+    if byz_mask is None:
+        byz_mask = core.default_byz_mask(
+            num_nodes, f if (attack or model_attack) else 0
+        )
+    byz_mask = jnp.asarray(byz_mask, bool)
+
+    init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
+    node_sharding = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+
+    def init_fn(key, example_x, seed_rng=None):
+        params, model_state = init_worker(key, example_x)
+        opt_state = optimizer.init(params)
+        stack = lambda tree: jax.tree.map(
+            lambda l: jnp.broadcast_to(l[None], (num_nodes,) + l.shape), tree
+        )
+        return core.TrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32), repl),
+            params=jax.device_put(stack(params), node_sharding),
+            model_state=jax.device_put(model_state, repl),
+            opt_state=jax.device_put(stack(opt_state), node_sharding),
+            rng=jax.device_put(key if seed_rng is None else seed_rng, repl),
+        )
+
+    def _local_step(state, x_local, y_local):
+        base = jax.random.fold_in(state.rng, state.step)
+        atk_key, gossip_key, matk_key, drop_base = jax.random.split(base, 4)
+        shard = jax.lax.axis_index(axis)
+        node_ids = shard * per_n + jnp.arange(per_n)
+
+        # Phase 1: per-node gradient on its own model + batch (unrolled over
+        # the static local slots; vmapping params over nodes trips conv
+        # batching rules).
+        grads, losses, ms_list = [], [], []
+        for k in range(per_n):
+            p_k = jax.tree.map(lambda l: l[k], state.params)
+            rng_k = jax.random.fold_in(drop_base, node_ids[k])
+            g, (loss, ms_out) = grad_fn(
+                p_k, state.model_state, x_local[k], y_local[k], rng_k
+            )
+            grads.append(ravel_pytree(g)[0])
+            losses.append(loss)
+            ms_list.append(ms_out)
+        flat_local = jnp.stack(grads)  # (per_n, d)
+        losses = jnp.stack(losses)
+        new_ms = core.mean_model_state(
+            jax.tree.map(lambda *ls: jnp.stack(ls), *ms_list), axis
+        )
+
+        # Phase 2: gather + attack + aggregate (= get_gradients of every peer).
+        stack0 = jax.lax.all_gather(flat_local, axis, tiled=True)  # (n, d)
+        stack0 = apply_gradient_attack(
+            attack, stack0, byz_mask, key=atk_key, **attack_params
+        )
+        aggr = gar.unchecked(stack0, f=f)  # identical on all honest nodes
+
+        # Phase 3: avg_agree rounds (ceil(log2 t), LEARN/trainer.py:208-222).
+        if non_iid:
+            t = jnp.maximum(state.step, 1).astype(jnp.float32)
+            rounds = jnp.ceil(jnp.log2(jnp.maximum(t, 2.0))).astype(jnp.int32)
+            rounds = jnp.minimum(rounds, max_rounds)
+
+            def round_body(r, aggr):
+                # Every round: each node publishes its current aggregate; the
+                # Byzantine rows are poisoned; re-aggregate.
+                served = jnp.broadcast_to(aggr[None], stack0.shape)
+                rkey = jax.random.fold_in(gossip_key, r)
+                served = apply_gradient_attack(
+                    attack, served, byz_mask, key=rkey, **attack_params
+                )
+                new = gar.unchecked(served, f=f)
+                return jnp.where(r < rounds, new, aggr)
+
+            aggr = jax.lax.fori_loop(0, max_rounds, round_body, aggr)
+
+        # Phase 4: per-node optimizer step.
+        new_params_list, new_opt_list = [], []
+        for k in range(per_n):
+            p_k = jax.tree.map(lambda l: l[k], state.params)
+            o_k = jax.tree.map(lambda l: l[k], state.opt_state)
+            updates, o_k = optimizer.update(
+                core.unflatten_like(p_k, aggr), o_k, p_k
+            )
+            new_params_list.append(optax.apply_updates(p_k, updates))
+            new_opt_list.append(o_k)
+        new_params = jax.tree.map(lambda *ls: jnp.stack(ls), *new_params_list)
+        new_opt = jax.tree.map(lambda *ls: jnp.stack(ls), *new_opt_list)
+
+        # Phase 5: model gossip (LEARN/trainer.py:255-257).
+        if model_gossip:
+            flat_models = core.flatten_rows(new_params)  # (per_n, d)
+            models = jax.lax.all_gather(flat_models, axis, tiled=True)
+            poisoned = jax.vmap(
+                lambda i, m: apply_model_attack(
+                    model_attack, m, key=jax.random.fold_in(matk_key, i),
+                    **model_attack_params,
+                )
+            )(jnp.arange(num_nodes), models)
+            models = jnp.where(byz_mask[:, None], poisoned, models)
+            aggr_model = gar.unchecked(models, f=f)
+            written = core.unflatten_like(
+                jax.tree.map(lambda l: l[0], new_params), aggr_model
+            )
+            new_params = jax.tree.map(
+                lambda l: jnp.broadcast_to(l[None], (per_n,) + l.shape),
+                written,
+            )
+
+        honest = (~byz_mask).astype(losses.dtype)[node_ids]
+        loss_num = jax.lax.psum(jnp.sum(losses * honest), axis)
+        loss_den = jax.lax.psum(jnp.sum(honest), axis)
+        mean_loss = loss_num / jnp.maximum(loss_den, 1.0)
+
+        return (
+            state.replace(
+                step=state.step + 1,
+                params=new_params,
+                model_state=new_ms,
+                opt_state=new_opt,
+            ),
+            {"loss": mean_loss},
+        )
+
+    state_specs = core.TrainState(
+        step=P(), params=P(axis), model_state=P(), opt_state=P(axis), rng=P()
+    )
+    sharded_step = jax.shard_map(
+        _local_step,
+        mesh=mesh,
+        in_specs=(state_specs, P(axis), P(axis)),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step_fn(state, x, y):
+        return sharded_step(state, x, y)
+
+    @jax.jit
+    def eval_fn(state, x):
+        params0 = jax.tree.map(lambda l: l[0], state.params)
+        return eval_apply(params0, state.model_state, x)
+
+    step_fn.mesh = mesh
+    step_fn.batch_sharding = node_sharding
+    return init_fn, step_fn, eval_fn

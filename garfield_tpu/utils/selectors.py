@@ -12,11 +12,13 @@ import optax
 
 
 def select_loss(name):
-    """Return ``loss_fn(logits, labels) -> scalar`` by name.
+    """Return ``loss_fn(outputs, labels) -> scalar`` by name.
 
     Supported: ``nll`` (expects log-probabilities), ``cross-entropy`` /
     ``crossentropy`` (expects raw logits), ``bce`` / ``binary-cross-entropy``
-    (expects a single logit per example, labels in {0, 1}).
+    (expects a *probability* per example like torch nn.BCELoss — the pima
+    model ends in sigmoid), ``bce-logits`` / ``bce-with-logits`` (expects a
+    single raw logit per example).
     """
     name = name.lower()
     if name == "nll":
@@ -32,13 +34,23 @@ def select_loss(name):
             )
         return ce
     if name in ("bce", "binary-cross-entropy"):
-        def bce(logits, labels):
+        # torch nn.BCELoss (tools.py:55) expects *probabilities* (the pima
+        # model ends in sigmoid, models/pimanet.py) — not logits.
+        def bce(probs, labels):
+            p = jnp.clip(probs.reshape(labels.shape), 1e-7, 1.0 - 1e-7)
+            labels = labels.astype(p.dtype)
+            return -jnp.mean(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p))
+        return bce
+    if name in ("bce-logits", "bce-with-logits"):
+        def bce_logits(logits, labels):
             logits = logits.reshape(labels.shape)
             return jnp.mean(
                 optax.sigmoid_binary_cross_entropy(logits, labels.astype(logits.dtype))
             )
-        return bce
-    raise ValueError(f"unknown loss {name!r}; available: nll, cross-entropy, bce")
+        return bce_logits
+    raise ValueError(
+        f"unknown loss {name!r}; available: nll, cross-entropy, bce, bce-logits"
+    )
 
 
 def select_optimizer(name, *, lr, momentum=0.0, weight_decay=0.0, **kwargs):
