@@ -1,0 +1,96 @@
+"""North-star benchmark: Byzantine-resilient SGD steps/sec/chip.
+
+Config (BASELINE.md measurement plan, mirroring Aggregathor/run_exp.sh:5-14):
+ResNet-18 / CIFAR-10, 8 logical workers folded onto the available chip(s),
+batch 25/worker, Multi-Krum with f=2 under the "little is enough" lie attack
+(byzWorker.py:108-125) — i.e. the full hot path: per-worker fwd+bwd,
+all_gather, on-device attack injection, O(n^2 d) Krum scoring, SGD update,
+all inside one jit'd SPMD program.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` divides by BASELINE.json's measured reference number when one
+exists; the reference repo publishes none (SURVEY §6), so it defaults to 1.0.
+
+Env knobs: GARFIELD_BENCH_STEPS (timed steps, default 20),
+GARFIELD_BENCH_WORKERS, GARFIELD_BENCH_F, GARFIELD_BENCH_BATCH.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    import optax
+
+    from garfield_tpu import models
+    from garfield_tpu.parallel import aggregathor, mesh as mesh_lib
+    from garfield_tpu.utils import selectors
+
+    num_workers = int(os.environ.get("GARFIELD_BENCH_WORKERS", 8))
+    f = int(os.environ.get("GARFIELD_BENCH_F", 2))
+    batch = int(os.environ.get("GARFIELD_BENCH_BATCH", 25))
+    steps = int(os.environ.get("GARFIELD_BENCH_STEPS", 20))
+
+    platform = jax.devices()[0].platform
+    # bf16 compute routes conv/matmul onto the MXU; params stay f32.
+    dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    module = models.select_model("resnet18", "cifar10", dtype=dtype)
+    loss_fn = selectors.select_loss("cross-entropy")
+    # Reference AggregaThor defaults: SGD lr 0.2, momentum 0.9, wd 5e-4
+    # (Aggregathor/run_exp.sh:39-40).
+    opt = selectors.select_optimizer(
+        "sgd", lr=0.2, momentum=0.9, weight_decay=5e-4
+    )
+
+    n_dev = len(jax.devices())
+    axis_size = n_dev if num_workers % n_dev == 0 else 1
+    mesh = mesh_lib.make_mesh(
+        {"workers": axis_size}, devices=jax.devices()[:axis_size]
+    )
+    init_fn, step_fn, _ = aggregathor.make_trainer(
+        module, loss_fn, opt, "krum",
+        num_workers=num_workers, f=f, attack="lie", mesh=mesh,
+    )
+
+    rng = np.random.default_rng(1234)
+    x = jnp.asarray(
+        rng.standard_normal((num_workers, batch, 32, 32, 3)), jnp.float32
+    )
+    y = jnp.asarray(rng.integers(0, 10, (num_workers, batch)), jnp.int32)
+    state = init_fn(jax.random.PRNGKey(1234), x[0])
+
+    for _ in range(3):  # warmup: compile + stabilize clocks
+        state, metrics = step_fn(state, x, y)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, x, y)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec_per_chip = steps / dt / axis_size
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as fp:
+            baseline = json.load(fp).get("published", {}).get(
+                "steps_per_sec_per_chip"
+            )
+    except OSError:
+        pass
+    vs = steps_per_sec_per_chip / baseline if baseline else 1.0
+    print(json.dumps({
+        "metric": "byzsgd_steps_per_sec_per_chip_resnet18_cifar10_w8_f2_krum_lie",
+        "value": round(steps_per_sec_per_chip, 4),
+        "unit": "steps/s/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

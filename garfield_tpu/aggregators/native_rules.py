@@ -1,0 +1,69 @@
+"""Registration of the native (C++) GAR variants.
+
+Counterpart of the reference's native registration blocks (e.g.
+pytorch_impl/libs/aggregators/krum.py:156-166 registers ``krum`` and, when
+``import native`` succeeded (:23-26), ``native-krum``). Here the native
+kernels live in garfield_tpu/native (ctypes over a JIT-built .so); they are
+registered lazily — the .so builds on first *call*, not at import — and only
+when a C++ toolchain is present.
+
+Inside a jit trace the wrappers route through ``jax.pure_callback`` (host
+callback), so ``gars["native-krum"]`` is usable in the same places as the XLA
+rules; on TPU this costs a device->host round trip and exists for parity and
+as the CPU production path, mirroring how the reference's CUDA natives were
+the GPU production path.
+"""
+
+import shutil
+
+import numpy as np
+
+from . import aksel, average, brute, bulyan, condense, krum, median, register
+
+
+def _native_call(fn_name, gradients, *args):
+    from .. import native
+
+    return getattr(native, fn_name)(np.asarray(gradients), *args)
+
+
+def _wrap(fn_name, *argnames):
+    def unchecked(gradients, f=None, m=None, **kwargs):
+        import jax
+        import jax.numpy as jnp
+
+        from ._common import as_stack
+
+        g = as_stack(gradients)
+        call_args = []
+        for name in argnames:
+            call_args.append({"f": f, "m": m}[name])
+        if isinstance(g, jax.core.Tracer):
+            return jax.pure_callback(
+                lambda garr: _native_call(fn_name, garr, *call_args),
+                jax.ShapeDtypeStruct((g.shape[1],), g.dtype),
+                g,
+                vmap_method="sequential",
+            )
+        return jnp.asarray(_native_call(fn_name, np.asarray(g), *call_args))
+
+    return unchecked
+
+
+if shutil.which("g++"):
+    register(
+        "native-krum", _wrap("krum", "f", "m"), krum.check,
+        upper_bound=krum.upper_bound, influence=krum.influence,
+    )
+    register(
+        "native-median", _wrap("median"), median.check,
+        upper_bound=median.upper_bound,
+    )
+    register(
+        "native-bulyan", _wrap("bulyan", "f", "m"), bulyan.check,
+        upper_bound=bulyan.upper_bound,
+    )
+    register(
+        "native-brute", _wrap("brute", "f"), brute.check,
+        upper_bound=brute.upper_bound,
+    )

@@ -1,0 +1,23 @@
+"""Application entry points (L4 of SURVEY §1).
+
+Each module mirrors one reference application's ``trainer.py`` CLI:
+
+  - ``centralized``  — pytorch_impl/applications/Centralized/  (P16)
+  - ``aggregathor``  — pytorch_impl/applications/Aggregathor/  (P17)
+  - ``byzsgd``       — pytorch_impl/applications/ByzSGD/       (P18)
+  - ``learn``        — pytorch_impl/applications/LEARN/        (P19)
+  - ``garfield_cc``  — pytorch_impl/applications/Garfield_CC/  (P20)
+
+Unlike the reference — where every node runs the same trainer.py and rank
+selects the role branch (Aggregathor/trainer.py:217-268) — the SPMD design
+has ONE process per host driving the whole mesh, so the CLIs keep the
+reference's flags (--dataset/--batch/--num_workers/--fw/--gar/...,
+trainer.py:62-135) but drop --master/--rank single-node plumbing; multi-host
+runs instead initialize jax.distributed (garfield_tpu/utils/multihost.py).
+
+Run as ``python -m garfield_tpu.apps.aggregathor --model resnet18 ...``.
+"""
+
+from . import common
+
+__all__ = ["common"]

@@ -1,0 +1,48 @@
+"""AggregaThor: single trusted PS, n workers, f Byzantine (SSMW).
+
+Counterpart of ``pytorch_impl/applications/Aggregathor/trainer.py`` (P17).
+The reference launches one process per node and branches on rank
+(:217-268); here one driver jits the whole round as an SPMD program over a
+"workers" mesh axis (garfield_tpu/parallel/aggregathor.py).
+
+Reference default experiment (run_exp.sh:5-14,39-40):
+
+  python -m garfield_tpu.apps.aggregathor --dataset cifar10 --model resnet50 \\
+      --batch 25 --num_workers 8 --fw 2 --gar krum --attack lie \\
+      --optimizer sgd --opt_args '{"lr":"0.2","momentum":"0.9","weight_decay":"0.0005"}' \\
+      --lr_decay_epochs 30 --num_iter 100000
+"""
+
+import sys
+
+from ..parallel import aggregathor
+from . import common
+
+
+def main(argv=None):
+    parser = common.base_parser(
+        "AggregaThor implementation using garfield-tpu"
+    )
+    args = parser.parse_args(argv)
+    assert args.fw * 2 < args.num_workers, (
+        "the number of Byzantine workers should be less than half the number "
+        "of workers"  # Aggregathor/trainer.py:150-152 invariant
+    )
+    return common.train(
+        args,
+        topology=aggregathor,
+        make_trainer_kwargs=dict(
+            num_workers=args.num_workers,
+            f=args.fw,
+            attack=args.attack,
+            attack_params=args.attack_params,
+            subset=args.subset,
+            granularity=args.granularity,
+        ),
+        num_slots=args.num_workers,
+        tag="aggregathor",
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,3 @@
+"""Microbenchmarks (P21): GAR kernel latency sweeps and collective-transfer
+latency, counterparts of pytorch_impl/applications/benchmarks/
+{gar_bench,rpc_bench}.py."""

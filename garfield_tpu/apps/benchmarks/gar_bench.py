@@ -1,0 +1,108 @@
+"""GAR kernel latency sweep.
+
+Counterpart of ``pytorch_impl/applications/benchmarks/gar_bench.py``
+(:41-89): per-GAR median latency across n in powers of two, f as allowed by
+each rule's contract, d in powers of ten — the same sweep grid, but timed as
+jit'd XLA executions (compile excluded, device-synchronized) and, for the
+``native-*`` rules, as C++ host kernels.
+
+  python -m garfield_tpu.apps.benchmarks.gar_bench --gars krum median \\
+      --ns 4 16 64 --ds 10 1000 100000 --reps 10 --json out.json
+"""
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import aggregators
+
+# Practical bound for brute's exhaustive enumeration, like the reference's
+# sweep bound (gar_bench.py:51 keeps n small for brute).
+BRUTE_MAX_N = 25
+
+
+def max_f(rule, n):
+    """Largest f each rule's contract admits (aggregators/*.check)."""
+    bounds = {
+        "krum": (n - 3) // 2,
+        "bulyan": (n - 3) // 4,
+        "brute": (n - 1) // 2,
+        "condense": (n - 2) // 2,
+        "aksel": (n - 1) // 2,
+        "median": (n - 1) // 2,
+        "average": (n - 1) // 2,
+    }
+    base = rule.split("native-")[-1]
+    return max(bounds.get(base, 0), 0)
+
+
+def bench_one(gar, n, f, d, reps, key):
+    g = jax.random.normal(key, (n, d), jnp.float32)
+    kwargs = {"f": f} if f else {}
+    try:
+        if gar.check(np.zeros((n, 2), np.float32), **kwargs) is not None:
+            return None
+    except TypeError:
+        pass
+    fn = jax.jit(lambda s: gar.unchecked(s, **kwargs))
+    out = fn(g)
+    jax.block_until_ready(out)  # compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(g))
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="GAR latency microbenchmark")
+    p.add_argument("--gars", nargs="*", default=sorted(aggregators.gars))
+    p.add_argument("--ns", nargs="*", type=int,
+                   default=[2 ** k for k in range(2, 8)])
+    p.add_argument("--ds", nargs="*", type=int,
+                   default=[10 ** k for k in range(1, 5)])
+    p.add_argument("--reps", type=int, default=10)
+    p.add_argument("--f_mode", choices=["max", "one"], default="max",
+                   help="f per (rule, n): contract maximum or fixed 1.")
+    p.add_argument("--json", type=str, default=None,
+                   help="Also dump results to this JSON file.")
+    args = p.parse_args(argv)
+
+    key = jax.random.PRNGKey(0)
+    results = []
+    for name in args.gars:
+        gar = aggregators.gars[name]
+        for n in args.ns:
+            if name.endswith("brute") and n > BRUTE_MAX_N:
+                continue
+            f = max_f(name, n) if args.f_mode == "max" else min(1, max_f(name, n))
+            for d in args.ds:
+                key, sub = jax.random.split(key)
+                try:
+                    latency = bench_one(gar, n, f, d, args.reps, sub)
+                except Exception as exc:
+                    print(f"{name} n={n} f={f} d={d}: SKIP ({exc})",
+                          file=sys.stderr)
+                    continue
+                if latency is None:
+                    continue
+                row = {"gar": name, "n": n, "f": f, "d": d,
+                       "median_s": latency}
+                results.append(row)
+                print(f"{name:>16} n={n:<4} f={f:<3} d={d:<7} "
+                      f"{latency * 1e3:8.3f} ms", flush=True)
+    if args.json:
+        with open(args.json, "w") as fp:
+            json.dump(results, fp, indent=1)
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,78 @@
+"""Model/gradient transfer latency over the mesh.
+
+Counterpart of ``pytorch_impl/applications/benchmarks/rpc_bench.py``
+(:95-118): the reference measures RPC model-fetch latency vs model dimension
+d and node count n. The SPMD equivalent of "every PS pulls every model /
+every worker's gradient" is one all_gather over the mesh axis, so this
+benchmark times a jit'd all_gather of a (d,)-vector per device across d and
+mesh sizes — the ICI-bandwidth number that bounds every topology's step.
+
+  python -m garfield_tpu.apps.benchmarks.transfer_bench --ds 1000 1000000
+"""
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...parallel import mesh as mesh_lib
+from ...utils import profiling
+
+
+def bench_gather(mesh, d, reps):
+    axis = mesh.axis_names[0]
+    k = mesh.shape[axis]
+
+    def gather(x_local):
+        return jax.lax.all_gather(x_local, axis, tiled=False)
+
+    fn = jax.jit(
+        jax.shard_map(gather, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    )
+    x = jnp.zeros((k, d), jnp.float32)
+    jax.block_until_ready(fn(x))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="collective transfer benchmark")
+    p.add_argument("--ds", nargs="*", type=int,
+                   default=[10 ** k for k in range(2, 8)])
+    p.add_argument("--reps", type=int, default=20)
+    p.add_argument("--json", type=str, default=None)
+    args = p.parse_args(argv)
+
+    n_dev = len(jax.devices())
+    sizes = sorted({s for s in (2, 4, 8, n_dev) if 1 < s <= n_dev})
+    results = []
+    for k in sizes:
+        mesh = mesh_lib.make_mesh({"workers": k}, devices=jax.devices()[:k])
+        for d in args.ds:
+            latency = bench_gather(mesh, d, args.reps)
+            payload = k * d * 4
+            row = {
+                "devices": k, "d": d, "median_s": latency,
+                "gather_gbit": profiling.convert_to_gbit(payload),
+                "gbit_per_s": profiling.convert_to_gbit(payload) / latency,
+            }
+            results.append(row)
+            print(f"k={k} d={d:<9} {latency * 1e6:9.1f} us "
+                  f"{row['gbit_per_s']:8.2f} Gbit/s", flush=True)
+    if args.json:
+        with open(args.json, "w") as fp:
+            json.dump(results, fp, indent=1)
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,60 @@
+"""ByzSGD / GuanYu: replicated Byzantine parameter servers (MSMW).
+
+Counterpart of ``pytorch_impl/applications/ByzSGD/trainer.py`` (P18): the
+AggregaThor step plus the model-space "gather step" (trainer.py:240-244) in
+which every PS pulls its peers' models, GAR-aggregates them, and writes the
+result back — tolerating fps Byzantine servers (byzServer.py attacks via
+--ps_attack).
+
+  python -m garfield_tpu.apps.byzsgd --dataset cifar10 --model resnet18 \\
+      --num_workers 8 --num_ps 3 --fw 2 --fps 1 --gar median \\
+      --attack lie --ps_attack random
+"""
+
+import sys
+
+from ..parallel import byzsgd
+from . import common
+
+
+def main(argv=None):
+    parser = common.base_parser("ByzSGD implementation using garfield-tpu")
+    parser.add_argument(
+        "--ps_attack", type=str, default=None,
+        help="Byzantine server model attack: random, reverse, drop "
+             "(byzServer.py:74-78).",
+    )
+    parser.add_argument(
+        "--ps_attack_params", type=__import__("json").loads, default={},
+        help="Model-attack parameters as JSON.",
+    )
+    parser.add_argument(
+        "--model_gar", type=str, default=None,
+        help="GAR for the model gather step (default: same as --gar, "
+             "ByzSGD/trainer.py:34 note).",
+    )
+    args = parser.parse_args(argv)
+    assert args.fw * 2 < args.num_workers
+    assert args.fps * 2 < args.num_ps or args.fps == 0
+    return common.train(
+        args,
+        topology=byzsgd,
+        make_trainer_kwargs=dict(
+            num_workers=args.num_workers,
+            num_ps=args.num_ps,
+            fw=args.fw,
+            fps=args.fps,
+            attack=args.attack,
+            attack_params=args.attack_params,
+            ps_attack=args.ps_attack,
+            ps_attack_params=args.ps_attack_params,
+            subset=args.subset,
+            model_gar=args.model_gar,
+        ),
+        num_slots=args.num_workers,
+        tag="byzsgd",
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

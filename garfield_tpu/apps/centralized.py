@@ -1,0 +1,36 @@
+"""Centralized baseline: one worker, one server, no distribution.
+
+Counterpart of ``pytorch_impl/applications/Centralized/trainer.py`` (P16):
+local Worker + Server objects wired without RPC. Here it is the AggregaThor
+topology degenerated to num_workers=1, f=0, gar=average on a 1-device mesh —
+the SPMD program contains no collectives at all, so XLA compiles a purely
+local train step.
+
+  python -m garfield_tpu.apps.centralized --model convnet --dataset mnist
+"""
+
+import sys
+
+from ..parallel import aggregathor
+from . import common
+
+
+def main(argv=None):
+    parser = common.base_parser("Centralized training baseline (garfield-tpu)")
+    args = parser.parse_args(argv)
+    args.num_workers = 1
+    args.fw = 0
+    args.attack = None
+    if not args.mesh:
+        args.mesh = "workers=1"  # single-device program, no collectives
+    return common.train(
+        args,
+        topology=aggregathor,
+        make_trainer_kwargs=dict(num_workers=1, f=0),
+        num_slots=1,
+        tag="centralized",
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

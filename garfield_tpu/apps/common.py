@@ -1,0 +1,268 @@
+"""Shared CLI scaffolding and training-loop driver for all applications.
+
+Flag surface mirrors the reference trainers (Aggregathor/trainer.py:62-135):
+--dataset/--batch/--num_workers/--num_ps/--fw/--fps/--model/--loss/
+--optimizer/--opt_args (JSON)/--num_iter/--gar/--acc_freq/--bench/--log, plus
+the knobs that were hard-coded or implicit there: --attack (byzWorker.py
+attack table), --subset (the wait-n-f async path, server.py:134-155),
+--granularity (Garfield_CC per-layer mode), --seed (torch.manual_seed(1234),
+trainer.py:210), --lr_decay*/--lr_decay_epochs (the x0.2/30-epoch hack,
+trainer.py:227-229), and new-capability flags: --checkpoint_dir/--resume
+(SURVEY §5: checkpointing is our deliberate upgrade), --profile_dir
+(jax.profiler), --mesh (device-axis layout).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import data as data_lib, models as models_lib, parallel
+from ..utils import checkpoint as ckpt_lib, profiling, selectors, tools
+
+__all__ = ["base_parser", "build_ingredients", "train"]
+
+
+def base_parser(description, *, default_model="convnet", default_loss="nll"):
+    p = argparse.ArgumentParser(
+        description=description, formatter_class=argparse.RawTextHelpFormatter
+    )
+    a = p.add_argument
+    a("--dataset", type=str, default="mnist",
+      help="Dataset to be used, e.g., mnist, cifar10, cifar100, pima.")
+    a("--batch", type=int, default=32,
+      help="Minibatch size employed by each worker.")
+    a("--num_workers", type=int, default=1, help="Number of workers.")
+    a("--num_ps", type=int, default=1, help="Number of parameter servers.")
+    a("--fw", type=int, default=0, help="Declared Byzantine workers.")
+    a("--fps", type=int, default=0, help="Declared Byzantine servers.")
+    a("--model", type=str, default=default_model,
+      help="Model name, e.g., convnet, cifarnet, resnet18, vgg16, ...")
+    a("--loss", type=str, default=default_loss,
+      help="Loss: nll, cross-entropy, bce.")
+    a("--optimizer", type=str, default="sgd",
+      help="Optimizer: sgd, adam, adamw, rmsprop, adagrad.")
+    a("--opt_args", type=json.loads, default={"lr": "0.1"},
+      help='Optimizer args as JSON, e.g., \'{"lr":"0.1","momentum":"0.9"}\'')
+    a("--num_iter", type=int, default=5000, help="Training iterations.")
+    a("--gar", type=str, default="average", help="Gradient aggregation rule.")
+    a("--acc_freq", type=int, default=100,
+      help="Iterations between accuracy evaluations.")
+    a("--bench", action="store_true",
+      help="Print per-step time and derived collective bandwidth.")
+    a("--log", action="store_true", help="Print loss every iteration.")
+    # --- knobs hard-coded in the reference ---
+    a("--attack", type=str, default=None,
+      help="Byzantine gradient attack: random, reverse, drop, lie, empire.")
+    a("--attack_params", type=json.loads, default={},
+      help="Attack parameters as JSON (e.g. lie z, empire eps).")
+    a("--subset", type=int, default=None,
+      help="Async wait-for-q emulation: aggregate a random q-subset "
+           "of worker gradients each step (server.py:134-155).")
+    a("--granularity", type=str, default="model", choices=["model", "layer"],
+      help="GAR over the whole flat gradient or per parameter tensor "
+           "(Garfield_CC semantics).")
+    a("--seed", type=int, default=1234, help="Base PRNG seed.")
+    a("--lr_decay", type=float, default=0.2,
+      help="LR decay factor applied every --lr_decay_epochs epochs.")
+    a("--lr_decay_epochs", type=int, default=0,
+      help="Epoch interval for LR step decay (reference uses 30 for "
+           "CIFAR-10; 0 disables).")
+    a("--train_size", type=int, default=None,
+      help="Optional cap on training-set size (debug/smoke).")
+    a("--dtype", type=str, default="float32",
+      choices=["float32", "bfloat16"],
+      help="Model compute dtype (bfloat16 routes matmuls to the MXU).")
+    # --- new capabilities (absent in the reference) ---
+    a("--checkpoint_dir", type=str, default=None,
+      help="Directory for orbax checkpoints (reference has none).")
+    a("--checkpoint_freq", type=int, default=1000,
+      help="Iterations between checkpoints.")
+    a("--resume", action="store_true",
+      help="Resume from the latest checkpoint in --checkpoint_dir.")
+    a("--profile_dir", type=str, default=None,
+      help="Write a jax.profiler trace of the steady-state steps here.")
+    a("--mesh", type=str, default=None,
+      help='Mesh axis layout, e.g. "workers=8" or "ps=2,workers=4"; '
+           "default: all devices on the topology's main axis.")
+    return p
+
+
+def parse_mesh(spec):
+    """'ps=2,workers=-1' -> Mesh. Fixed-size specs smaller than the device
+    count use the first prod(sizes) devices (a run may occupy a sub-slice of
+    the chips, like the reference running fewer ranks than hosts)."""
+    if not spec:
+        return None
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        axes[name.strip()] = int(size)
+    devices = None
+    sizes = list(axes.values())
+    if -1 not in sizes:
+        import math
+
+        total = math.prod(sizes)
+        devices = jax.devices()[:total]
+    return parallel.mesh.make_mesh(axes, devices=devices)
+
+
+def _coerce_opt_args(opt_args):
+    """Reference CLIs pass numbers as strings ('{"lr":"0.2"}'); coerce."""
+    out = {}
+    for k, v in opt_args.items():
+        try:
+            out[k] = float(v)
+        except (TypeError, ValueError):
+            out[k] = v
+    return out
+
+
+def build_ingredients(args, iters_per_epoch=None):
+    """(module, loss_fn, optimizer) from the CLI flags — the selector layer
+    (garfieldpp/tools.py:47-123) applied exactly as the trainers do."""
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    module = models_lib.select_model(args.model, args.dataset, dtype=dtype)
+    loss_fn = selectors.select_loss(args.loss)
+    opt_args = _coerce_opt_args(dict(args.opt_args))
+    lr = opt_args.pop("lr", 0.1)
+    if args.lr_decay_epochs and iters_per_epoch:
+        lr = selectors.adjust_learning_rate(
+            lr, decay=args.lr_decay,
+            every_epochs=args.lr_decay_epochs,
+            iters_per_epoch=iters_per_epoch,
+        )
+    optimizer = selectors.select_optimizer(
+        args.optimizer, lr=lr,
+        momentum=opt_args.pop("momentum", 0.0),
+        weight_decay=opt_args.pop("weight_decay", 0.0),
+        **opt_args,
+    )
+    return module, loss_fn, optimizer
+
+
+def load_data(args, num_slots):
+    """Stacked per-slot batch streams + test set.
+
+    ``num_slots`` is the leading axis the topology shards (workers for
+    aggregathor/byzsgd, nodes for learn). Returns
+    (xs, ys, test_batches, iters_per_epoch) with xs: (S, B, bsz, ...).
+    """
+    manager = data_lib.DatasetManager(
+        args.dataset, args.batch, num_slots, num_slots, 0,
+        train_size=args.train_size,
+    )
+    manager.num_ps = 0  # slots are pure data partitions here
+    xs, ys = manager.sharded_train_batches()
+    test = manager.get_test_set()
+    return xs, ys, test, xs.shape[1]
+
+
+def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
+    """The reference training loop (Aggregathor/trainer.py:226-264), SPMD:
+    batch selection by step index (batch i = train_set[i % len],
+    worker.py:87), jit'd step, periodic accuracy, optional bench/profile
+    instrumentation, optional checkpointing."""
+    t_start = time.time()
+    xs_np, ys_np, test_batches, iters_per_epoch = load_data(args, num_slots)
+    tools.info(
+        f"[{tag}] One EPOCH consists of {iters_per_epoch} iterations"
+    )
+    module, loss_fn, optimizer = build_ingredients(args, iters_per_epoch)
+    mesh = parse_mesh(args.mesh)
+    init_fn, step_fn, eval_fn = topology.make_trainer(
+        module, loss_fn, optimizer,
+        args.gar, mesh=mesh, **make_trainer_kwargs,
+    )
+
+    xs = jax.device_put(jnp.asarray(xs_np), step_fn.batch_sharding)
+    ys = jax.device_put(jnp.asarray(ys_np), step_fn.batch_sharding)
+    key = jax.random.PRNGKey(args.seed)
+    state = init_fn(key, xs_np[0, 0])
+
+    ckpt = None
+    start_iter = 0
+    if args.checkpoint_dir:
+        ckpt = ckpt_lib.Checkpointer(args.checkpoint_dir)
+        if args.resume and ckpt.latest_step() is not None:
+            state = jax.device_put(
+                ckpt.restore(jax.tree.map(np.asarray, state)),
+                jax.tree.map(lambda l: l.sharding, state),
+            )
+            start_iter = int(np.asarray(state.step))
+            tools.info(f"[{tag}] resumed from step {start_iter}")
+
+    timer = profiling.StepTimer()
+    d = int(sum(np.prod(l.shape) for l in jax.tree.leaves(state.params)))
+    binary = args.dataset == "pima"
+    num_batches = xs.shape[1]
+    metrics = {}
+
+    t_train = time.time()
+    for i in range(start_iter, args.num_iter):
+        b = i % num_batches
+        profiling_this = args.profile_dir and i == start_iter + 5
+        with profiling.trace(args.profile_dir if profiling_this else None):
+            if args.bench:
+                # Honest per-step numbers require a device sync; without
+                # --bench we leave dispatch asynchronous (faster) and report
+                # only whole-run throughput below.
+                with timer.step(block_on=None):
+                    state, metrics = step_fn(state, xs[:, b], ys[:, b])
+                    jax.block_until_ready(metrics["loss"])
+            else:
+                state, metrics = step_fn(state, xs[:, b], ys[:, b])
+        if args.bench:
+            byz_bytes = profiling.collective_bytes(
+                tag, num_workers=num_slots, d=d,
+                num_ps=getattr(args, "num_ps", 1),
+                axis_size=step_fn.mesh.shape[
+                    step_fn.mesh.axis_names[-1]
+                ],
+            )
+            print(
+                f"Training step {i} takes {timer.last():.4f} seconds",
+                flush=True,
+            )
+            print(
+                "Consumed bandwidth in this iteration: "
+                f"{profiling.convert_to_gbit(byz_bytes):.4f} Gbits",
+                flush=True,
+            )
+        if args.log:
+            print(f"Loss {i}: {float(metrics['loss']):.6f}", flush=True)
+        if args.acc_freq and i % args.acc_freq == 0:
+            acc = parallel.compute_accuracy(
+                state, eval_fn, test_batches, binary=binary
+            )
+            print(
+                f"Epoch: {i / max(iters_per_epoch, 1):.2f} "
+                f"Accuracy: {acc:.4f} Time: {time.time() - t_start:.1f}",
+                flush=True,
+            )
+        if ckpt and args.checkpoint_freq and (i + 1) % args.checkpoint_freq == 0:
+            ckpt.save(i + 1, jax.tree.map(np.asarray, state))
+
+    jax.block_until_ready(state.step)  # drain async dispatch for honest wall
+    train_wall = time.time() - t_train
+    steps_done = args.num_iter - start_iter
+    acc = parallel.compute_accuracy(state, eval_fn, test_batches, binary=binary)
+    summary = {
+        "final_accuracy": acc,
+        "final_loss": float(metrics["loss"]) if metrics else None,
+        "wall_s": time.time() - t_start,
+        "train_wall_s": train_wall,
+        "steps_per_sec": steps_done / train_wall if train_wall > 0 else None,
+        **{f"step_{k}": v for k, v in timer.summary().items()},
+    }
+    print(json.dumps({"tag": tag, **summary}), flush=True)
+    if ckpt:
+        if args.checkpoint_freq:
+            ckpt.save(args.num_iter, jax.tree.map(np.asarray, state))
+        ckpt.close()
+    return state, summary

@@ -1,0 +1,214 @@
+"""Browser demo: decentralized Byzantine-resilient LEARN on Pima.
+
+Counterpart of ``pytorch_impl/applications/LEARN/demo.py`` (P22): the
+reference spawns n ``multiprocessing.Process`` ranks on localhost behind a
+Quart app (:244-349, routes :401-441). Here the n nodes are logical slots of
+one jit'd SPMD program (the "multi-node on one host" harness is the mesh
+itself), the web layer is stdlib ``http.server`` (no Quart in this image),
+and training runs in a background thread publishing progress:
+
+  POST /train {"nodes": 8, "f": 1, "gar": "median", "attack": "lie"}
+  GET  /status -> {"running", "step", "total", "loss", "accuracy", ...}
+  GET  /       -> minimal HTML page driving the two endpoints
+
+  python -m garfield_tpu.apps.demo --port 8000
+"""
+
+import argparse
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import numpy as np
+
+from .. import data as data_lib, models as models_lib, parallel
+from ..parallel import learn
+from ..utils import selectors, tools
+
+_PAGE = """<!doctype html>
+<html><head><title>garfield-tpu LEARN demo</title></head>
+<body style="font-family:sans-serif;max-width:40em;margin:2em auto">
+<h2>Byzantine-resilient collaborative learning (LEARN, Pima)</h2>
+<form onsubmit="start(event)">
+  nodes <input id=n value=8 size=2>
+  f <input id=f value=1 size=2>
+  gar <select id=g><option>median<option>krum<option>average<option>aksel
+      </select>
+  attack <select id=a><option>none<option>lie<option>random<option>reverse
+      <option>empire<option>drop</select>
+  epochs <input id=e value=15 size=3>
+  <button>train</button>
+</form>
+<pre id=out>idle</pre>
+<script>
+async function start(ev) {
+  ev.preventDefault();
+  await fetch('/train', {method:'POST', body: JSON.stringify({
+    nodes:+document.getElementById('n').value,
+    f:+document.getElementById('f').value,
+    gar:document.getElementById('g').value,
+    attack:document.getElementById('a').value,
+    epochs:+document.getElementById('e').value})});
+  poll();
+}
+async function poll() {
+  const r = await (await fetch('/status')).json();
+  document.getElementById('out').textContent = JSON.stringify(r, null, 1);
+  if (r.running) setTimeout(poll, 500);
+}
+poll();
+</script></body></html>"""
+
+
+class DemoState:
+    """Progress shared between the trainer thread and HTTP handlers
+    (the reference's progress queue + lock, demo.py:260, 305-320)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.progress = {"running": False}
+        self.thread = None
+
+    def update(self, **kw):
+        with self.lock:
+            self.progress.update(kw)
+
+    def snapshot(self):
+        with self.lock:
+            return dict(self.progress)
+
+
+STATE = DemoState()
+
+
+def run_training(nodes, f, gar, attack, epochs, batch=16):
+    """LEARN on pima/pimanet — the reference demo's fixed config
+    (demo.py:267-270,294: batch 16, 15 epochs, rmsprop lr 1e-3)."""
+    try:
+        t0 = time.time()
+        manager = data_lib.DatasetManager("pima", batch, nodes, nodes, 0)
+        manager.num_ps = 0
+        xs, ys = manager.sharded_train_batches()
+        test = manager.get_test_set()
+        iters_per_epoch = xs.shape[1]
+        total = epochs * iters_per_epoch
+        module = models_lib.select_model("pimanet", "pima")
+        loss_fn = selectors.select_loss("bce")
+        optimizer = selectors.select_optimizer(
+            "rmsprop", lr=1e-3, momentum=0.9, weight_decay=5e-4
+        )
+        n_dev = len(jax.devices())
+        axis = n_dev if nodes % n_dev == 0 else 1
+        mesh = parallel.mesh.make_mesh(
+            {"nodes": axis}, devices=jax.devices()[:axis]
+        )
+        init_fn, step_fn, eval_fn = learn.make_trainer(
+            module, loss_fn, optimizer, gar,
+            num_nodes=nodes, f=f,
+            attack=None if attack in (None, "none") else attack,
+            mesh=mesh,
+        )
+        state = init_fn(jax.random.PRNGKey(1234), xs[0, 0])
+        xs = jax.device_put(jax.numpy.asarray(xs), step_fn.batch_sharding)
+        ys = jax.device_put(jax.numpy.asarray(ys), step_fn.batch_sharding)
+        metrics = {}
+        for i in range(total):
+            state, metrics = step_fn(state, xs[:, i % iters_per_epoch],
+                                     ys[:, i % iters_per_epoch])
+            if i % iters_per_epoch == 0 or i == total - 1:
+                acc = parallel.compute_accuracy(
+                    state, eval_fn, test, binary=True
+                )
+                STATE.update(
+                    running=True, step=i + 1, total=total,
+                    epoch=i // iters_per_epoch,
+                    loss=float(metrics["loss"]), accuracy=acc,
+                    elapsed_s=round(time.time() - t0, 1),
+                )
+        acc = parallel.compute_accuracy(state, eval_fn, test, binary=True)
+        STATE.update(running=False, step=total, accuracy=acc,
+                     loss=float(metrics["loss"]),
+                     elapsed_s=round(time.time() - t0, 1), done=True)
+    except Exception as exc:  # surfaced via /status, like demo.py's liveness
+        STATE.update(running=False, error=repr(exc))
+
+
+class Handler(BaseHTTPRequestHandler):
+    def _send(self, code, body, ctype="application/json"):
+        data = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/":
+            self._send(200, _PAGE, "text/html")
+        elif self.path == "/status":
+            self._send(200, json.dumps(STATE.snapshot()))
+        else:
+            self._send(404, json.dumps({"error": "not found"}))
+
+    def do_POST(self):
+        if self.path != "/train":
+            self._send(404, json.dumps({"error": "not found"}))
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError:
+            self._send(400, json.dumps({"error": "bad json"}))
+            return
+        # check-then-spawn under the lock: ThreadingHTTPServer handles
+        # concurrent POSTs on separate threads.
+        with STATE.lock:
+            if STATE.thread and STATE.thread.is_alive():
+                self._send(
+                    409, json.dumps({"error": "training already running"})
+                )
+                return
+            STATE.progress.update(running=True, step=0, error=None,
+                                  done=False)
+            STATE.thread = threading.Thread(
+                target=run_training,
+                kwargs=dict(
+                    nodes=int(req.get("nodes", 8)),
+                    f=int(req.get("f", 1)),
+                    gar=req.get("gar", "median"),
+                    attack=req.get("attack", "none"),
+                    epochs=int(req.get("epochs", 15)),
+                ),
+                daemon=True,
+            )
+            STATE.thread.start()
+        self._send(200, json.dumps({"started": True}))
+
+    def log_message(self, fmt, *args):  # route through our logger
+        tools.trace("[demo] " + fmt % args)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="garfield-tpu LEARN web demo")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--warm", action="store_true",
+                   help="Run one tiny training first (the reference's "
+                        "init_demo warm build, demo.py:440).")
+    args = p.parse_args(argv)
+    if args.warm:
+        run_training(nodes=4, f=0, gar="average", attack="none", epochs=1)
+    server = ThreadingHTTPServer((args.host, args.port), Handler)
+    tools.info(f"[demo] serving on http://{args.host}:{args.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return server
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

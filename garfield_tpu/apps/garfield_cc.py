@@ -1,0 +1,89 @@
+"""Garfield_CC: collective-communication training (per-layer GARs).
+
+Counterpart of ``pytorch_impl/applications/Garfield_CC/trainer.py`` (P20) —
+the reference's monolithic torch.distributed implementation whose
+``reduce_gradients`` loops over model layers doing gather -> GAR -> broadcast
+per parameter tensor (:55-204). Its three modes map to:
+
+  - ``--mode vanilla``     dist.reduce(SUM)/n (:84-89)      -> average GAR
+  - ``--mode aggregathor`` gather+GAR at one PS (:91-127)   -> SSMW topology
+  - ``--mode guanyu``      Byzantine-PS path (:104-196)     -> MSMW topology
+                           with model GAR (``mar``)
+
+All modes use ``granularity="layer"`` so the GAR runs per parameter tensor
+exactly like the reference's per-layer loop — on TPU the gather is one
+all_gather per tensor and the "broadcast back" disappears (SPMD replication).
+The ``mar='crash'`` crash-fault mode maps to --ps_attack drop.
+
+  python -m garfield_tpu.apps.garfield_cc --mode aggregathor \\
+      --dataset cifar10 --model resnet18 --num_workers 8 --fw 2 --gar median
+"""
+
+import json
+import sys
+
+from ..parallel import aggregathor, byzsgd
+from . import common
+
+
+def main(argv=None):
+    parser = common.base_parser(
+        "Garfield collective-communication trainer (garfield-tpu)"
+    )
+    parser.add_argument(
+        "--mode", type=str, default="aggregathor",
+        choices=["vanilla", "aggregathor", "guanyu"],
+        help="Communication scheme (Garfield_CC/trainer.py:84-196).",
+    )
+    parser.add_argument(
+        "--mar", type=str, default=None,
+        help="Model aggregation rule for guanyu (default: --gar; "
+             "Garfield_CC/trainer.py:163-168).",
+    )
+    parser.add_argument(
+        "--ps_attack", type=str, default=None,
+        help="Byzantine server model attack for guanyu mode.",
+    )
+    args = parser.parse_args(argv)
+    args.granularity = "layer"
+    if args.mode == "vanilla":
+        args.gar = "average"
+        args.attack = None
+        args.fw = 0
+    if args.mode in ("vanilla", "aggregathor"):
+        return common.train(
+            args,
+            topology=aggregathor,
+            make_trainer_kwargs=dict(
+                num_workers=args.num_workers,
+                f=args.fw,
+                attack=args.attack,
+                attack_params=args.attack_params,
+                subset=args.subset,
+                granularity="layer",
+            ),
+            num_slots=args.num_workers,
+            tag="garfield_cc",
+        )
+    return common.train(
+        args,
+        topology=byzsgd,
+        make_trainer_kwargs=dict(
+            num_workers=args.num_workers,
+            num_ps=args.num_ps,
+            fw=args.fw,
+            fps=args.fps,
+            attack=args.attack,
+            attack_params=args.attack_params,
+            ps_attack=args.ps_attack,
+            model_gar=args.mar,
+            subset=args.subset,
+            granularity="layer",
+        ),
+        num_slots=args.num_workers,
+        tag="garfield_cc",
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,57 @@
+"""LEARN: fully decentralized Byzantine-resilient collaborative learning.
+
+Counterpart of ``pytorch_impl/applications/LEARN/trainer.py`` (P19): every
+node is Worker + Server (:224-231); per step each node aggregates everyone's
+gradients, optionally runs ceil(log2 t) extra agreement rounds for non-iid
+data (:208-222, :251-252), then gossips and GAR-aggregates models (:255-257).
+``--num_workers`` is the node count (the reference demo calls it n).
+
+  python -m garfield_tpu.apps.learn --dataset pima --model pimanet \\
+      --loss bce --num_workers 8 --fw 1 --gar median \\
+      --optimizer rmsprop --opt_args '{"lr":"0.001","momentum":"0.9","weight_decay":"0.0005"}'
+"""
+
+import sys
+
+from ..parallel import learn
+from . import common
+
+
+def main(argv=None):
+    parser = common.base_parser(
+        "LEARN implementation using garfield-tpu", default_loss="bce"
+    )
+    parser.add_argument(
+        "--non_iid", action="store_true",
+        help="Enable the ceil(log2 t) agreement rounds "
+             "(LEARN/trainer.py:251-252).",
+    )
+    parser.add_argument(
+        "--model_attack", type=str, default=None,
+        help="Byzantine model-gossip attack: random, reverse, drop.",
+    )
+    parser.add_argument(
+        "--no_model_gossip", action="store_true",
+        help="Disable the model gossip phase (LEARN/trainer.py:255-257).",
+    )
+    args = parser.parse_args(argv)
+    assert args.fw * 2 < args.num_workers or args.fw == 0
+    return common.train(
+        args,
+        topology=learn,
+        make_trainer_kwargs=dict(
+            num_nodes=args.num_workers,
+            f=args.fw,
+            attack=args.attack,
+            attack_params=args.attack_params,
+            model_attack=args.model_attack,
+            non_iid=args.non_iid,
+            model_gossip=not args.no_model_gossip,
+        ),
+        num_slots=args.num_workers,
+        tag="learn",
+    )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,264 @@
+"""Native (C++) runtime: JIT build system + ctypes bindings.
+
+Counterpart of the reference's native loader
+(pytorch_impl/libs/native/__init__.py:19-152): that one scans ``so_*``/
+``py_*`` directories, resolves ``.deps`` files and compiles each module via
+``torch.utils.cpp_extension.load`` at import time with env knobs
+NATIVE_OPT/NATIVE_STD/NATIVE_QUIET (:37-50). This one compiles the sources
+under ``src/`` into one shared object with g++ (no pybind11 in this image;
+the Python boundary is a C ABI over ctypes), caches it by content hash under
+``~/.cache/garfield_tpu/native`` (incremental: same sources + flags => reuse),
+and exposes typed numpy wrappers.
+
+Env knobs (reference parity):
+  GARFIELD_NATIVE_OPT     extra optimization flags (default "-O3");
+                          "-O0 -g" gives the reference's debug build (:72-74)
+  GARFIELD_NATIVE_STD     C++ standard (default "c++17")
+  GARFIELD_NATIVE_QUIET   suppress build logging
+  GARFIELD_NATIVE_DISABLE force-disable (``available()`` returns False)
+
+Import never raises: if the toolchain or build fails, ``available()`` is
+False and the ``native-*`` GARs simply do not register (the reference's
+``import native`` try/except, aggregators/krum.py:23-26).
+"""
+
+import ctypes
+import hashlib
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+
+from ..utils import tools
+
+__all__ = [
+    "available",
+    "load",
+    "krum",
+    "median",
+    "bulyan",
+    "brute",
+    "num_threads",
+    "MultiBuffer",
+]
+
+_SRC_DIR = pathlib.Path(__file__).parent / "src"
+_lib = None
+_load_error = None
+
+
+def _cache_dir():
+    root = os.environ.get(
+        "GARFIELD_NATIVE_CACHE",
+        os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "garfield_tpu",
+            "native",
+        ),
+    )
+    return pathlib.Path(root)
+
+
+def _build():
+    """Compile src/*.cpp into one cached .so; return its path."""
+    opt = os.environ.get("GARFIELD_NATIVE_OPT", "-O3").split()
+    std = os.environ.get("GARFIELD_NATIVE_STD", "c++17")
+    sources = sorted(_SRC_DIR.glob("*.cpp"))
+    headers = sorted(_SRC_DIR.glob("*.hpp"))
+    if not sources:
+        raise FileNotFoundError(f"no native sources under {_SRC_DIR}")
+    flags = [
+        f"-std={std}", "-fPIC", "-shared", "-pthread",
+        "-fvisibility=hidden", *opt,
+    ]
+    if __debug__ and "NDEBUG" not in " ".join(opt):
+        pass  # keep asserts, mirroring the reference's __debug__ coupling
+    else:
+        flags.append("-DNDEBUG")
+    h = hashlib.sha256()
+    for path in sources + headers:
+        h.update(path.name.encode())
+        h.update(path.read_bytes())
+    h.update(" ".join(flags).encode())
+    out = _cache_dir() / h.hexdigest()[:16] / "libgarfield_native.so"
+    if out.exists():
+        return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    cmd = ["g++", *flags, *(str(s) for s in sources), "-o", str(out) + ".tmp"]
+    if not os.environ.get("GARFIELD_NATIVE_QUIET"):
+        tools.info(f"[native] building: {' '.join(cmd)}")
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(str(out) + ".tmp", out)
+    return out
+
+
+def load():
+    """Build (if needed) and dlopen the native library; cached."""
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return _lib
+    if os.environ.get("GARFIELD_NATIVE_DISABLE"):
+        _load_error = RuntimeError("disabled via GARFIELD_NATIVE_DISABLE")
+        return None
+    try:
+        lib = ctypes.CDLL(str(_build()))
+    except Exception as exc:  # toolchain missing / build failure
+        _load_error = exc
+        if not os.environ.get("GARFIELD_NATIVE_QUIET"):
+            tools.warning(f"[native] unavailable: {exc}")
+        return None
+    i64 = ctypes.c_int64
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    for suffix, ptr in (("f32", f32p), ("f64", f64p)):
+        getattr(lib, f"gt_krum_{suffix}").argtypes = [ptr, i64, i64, i64, i64, ptr]
+        getattr(lib, f"gt_median_{suffix}").argtypes = [ptr, i64, i64, ptr]
+        getattr(lib, f"gt_bulyan_{suffix}").argtypes = [ptr, i64, i64, i64, i64, ptr]
+        getattr(lib, f"gt_brute_{suffix}").argtypes = [ptr, i64, i64, i64, ptr]
+    lib.gt_num_threads.restype = i64
+    lib.gt_multibuffer_new.argtypes = [i64]
+    lib.gt_multibuffer_new.restype = ctypes.c_void_p
+    lib.gt_multibuffer_free.argtypes = [ctypes.c_void_p]
+    lib.gt_multibuffer_write.argtypes = [ctypes.c_void_p, i64, u8p, i64]
+    lib.gt_multibuffer_write.restype = i64
+    lib.gt_multibuffer_wait.argtypes = [ctypes.c_void_p, i64, i64, i64]
+    lib.gt_multibuffer_wait.restype = i64
+    lib.gt_multibuffer_read.argtypes = [
+        ctypes.c_void_p, i64, u8p, i64, ctypes.POINTER(i64)
+    ]
+    lib.gt_multibuffer_read.restype = i64
+    lib.gt_multibuffer_version.argtypes = [ctypes.c_void_p, i64]
+    lib.gt_multibuffer_version.restype = i64
+    _lib = lib
+    return _lib
+
+
+def available():
+    return load() is not None
+
+
+def _as_2d(gradients, dtype=None):
+    if isinstance(gradients, (list, tuple)):
+        g = np.stack([np.asarray(v).reshape(-1) for v in gradients])
+    else:
+        g = np.asarray(gradients)
+    if g.ndim != 2:
+        raise ValueError(f"expected (n, d) stack, got shape {g.shape}")
+    if dtype is None:
+        dtype = np.float64 if g.dtype == np.float64 else np.float32
+    return np.ascontiguousarray(g, dtype=dtype)
+
+
+def _ptr(a):
+    ct = ctypes.c_double if a.dtype == np.float64 else ctypes.c_float
+    return a.ctypes.data_as(ctypes.POINTER(ct))
+
+
+def _dispatch(name, g):
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_load_error}")
+    suffix = "f64" if g.dtype == np.float64 else "f32"
+    return getattr(lib, f"gt_{name}_{suffix}")
+
+
+def krum(gradients, f, m=None):
+    """Native Multi-Krum (py_krum/krum.cpp counterpart)."""
+    g = _as_2d(gradients)
+    out = np.empty(g.shape[1], dtype=g.dtype)
+    _dispatch("krum", g)(_ptr(g), g.shape[0], g.shape[1], int(f),
+                         int(m) if m else 0, _ptr(out))
+    return out
+
+
+def median(gradients):
+    """Native coordinate-wise lower median (py_median counterpart)."""
+    g = _as_2d(gradients)
+    out = np.empty(g.shape[1], dtype=g.dtype)
+    _dispatch("median", g)(_ptr(g), g.shape[0], g.shape[1], _ptr(out))
+    return out
+
+
+def bulyan(gradients, f, m=None):
+    """Native Bulyan (py_bulyan counterpart)."""
+    g = _as_2d(gradients)
+    out = np.empty(g.shape[1], dtype=g.dtype)
+    _dispatch("bulyan", g)(_ptr(g), g.shape[0], g.shape[1], int(f),
+                           int(m) if m else 0, _ptr(out))
+    return out
+
+
+def brute(gradients, f):
+    """Native brute min-diameter selection (py_brute counterpart)."""
+    g = _as_2d(gradients)
+    out = np.empty(g.shape[1], dtype=g.dtype)
+    _dispatch("brute", g)(_ptr(g), g.shape[0], g.shape[1], int(f), _ptr(out))
+    return out
+
+
+def num_threads():
+    lib = load()
+    return int(lib.gt_num_threads()) if lib else 0
+
+
+class MultiBuffer:
+    """MRMW atomic register array with blocking reads (T9 counterpart).
+
+    ``write(slot, bytes)`` replaces the slot value (last-writer-wins);
+    ``read(slot, min_version, timeout_ms)`` blocks until the slot has been
+    written at least ``min_version`` times, then returns (version, bytes).
+    Used by the multi-host control plane to hand serialized models/gradients
+    between threads without polling (the reference's history lists poll at
+    1 ms, grpc_message_exchange_servicer.py:58-65).
+    """
+
+    def __init__(self, nslots):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native runtime unavailable: {_load_error}")
+        self._lib = lib
+        self._handle = lib.gt_multibuffer_new(int(nslots))
+        self.nslots = int(nslots)
+
+    def write(self, slot, data):
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(bytes(data))
+        return int(self._lib.gt_multibuffer_write(
+            self._handle, int(slot), buf, len(data)
+        ))
+
+    def read(self, slot, min_version=1, timeout_ms=-1):
+        size = int(self._lib.gt_multibuffer_wait(
+            self._handle, int(slot), int(min_version), int(timeout_ms)
+        ))
+        if size < 0:
+            raise TimeoutError(
+                f"multibuffer slot {slot} not at version {min_version} "
+                f"within {timeout_ms} ms"
+            )
+        out = (ctypes.c_uint8 * size)()
+        version = ctypes.c_int64(0)
+        actual = int(self._lib.gt_multibuffer_read(
+            self._handle, int(slot), out, size, ctypes.byref(version)
+        ))
+        if actual < 0:  # concurrent grow between wait and read: retry
+            return self.read(slot, min_version, timeout_ms)
+        # A concurrent write may have shrunk the slot; `actual` is the real
+        # payload length, so never hand back stale padding bytes.
+        return int(version.value), bytes(out)[:actual]
+
+    def version(self, slot):
+        return int(self._lib.gt_multibuffer_version(self._handle, int(slot)))
+
+    def close(self):
+        if self._handle:
+            self._lib.gt_multibuffer_free(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,107 @@
+// Multi-reader/multi-writer atomic register with blocking
+// producer-consumer semantics.
+//
+// Counterpart of the reference's multibuffer op
+// (tensorflow_impl/.../rsrcs/native/op_multibuffer/op.cpp:11 — "MRMW
+// read-only/write-only atomic register with blocking producer-consumer
+// semantic", backed by include/multiregister.hpp) — re-designed: the
+// reference uses a lock-free multi-buffer scheme to hand tensors between TF
+// graph threads; here the register hands host-side payloads (serialized
+// model/gradient blobs) between the driver thread and host-callback /
+// multi-host RPC threads, so a seqlock-free mutex+condvar design is
+// sufficient and formally simpler:
+//
+//   - write(slot, data): atomically replaces the slot's value and bumps its
+//     version; never blocks (last-writer-wins, like a register — not a queue);
+//   - read(slot, min_version): blocks until the slot's version is
+//     >= min_version, then copies out a consistent snapshot. Version 1 is the
+//     first write, so read(slot, 1) is "wait until somebody wrote" — the
+//     same synchronization the TF servicer's history-polling loop provides
+//     (grpc_message_exchange_servicer.py:58-65), without the 1 ms spin.
+//
+// C ABI for ctypes.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<uint8_t> data;
+  int64_t version = 0;
+};
+
+struct MultiBuffer {
+  explicit MultiBuffer(int64_t nslots) : slots(nslots) {}
+  std::vector<Slot> slots;
+};
+
+}  // namespace
+
+#define GT_EXPORT __attribute__((visibility("default")))
+
+extern "C" {
+
+GT_EXPORT void* gt_multibuffer_new(int64_t nslots) {
+  return new MultiBuffer(nslots);
+}
+
+GT_EXPORT void gt_multibuffer_free(void* mb) { delete static_cast<MultiBuffer*>(mb); }
+
+// Atomically replace slot contents; returns the new version.
+GT_EXPORT int64_t gt_multibuffer_write(void* mb, int64_t slot, const uint8_t* data,
+                             int64_t nbytes) {
+  Slot& s = static_cast<MultiBuffer*>(mb)->slots[slot];
+  int64_t v;
+  {
+    std::lock_guard<std::mutex> lk(s.mu);
+    s.data.assign(data, data + nbytes);
+    v = ++s.version;
+  }
+  s.cv.notify_all();
+  return v;
+}
+
+// Current byte size once version >= min_version (blocking); use to size the
+// read buffer. timeout_ms < 0 means wait forever; returns -1 on timeout.
+GT_EXPORT int64_t gt_multibuffer_wait(void* mb, int64_t slot, int64_t min_version,
+                            int64_t timeout_ms) {
+  Slot& s = static_cast<MultiBuffer*>(mb)->slots[slot];
+  std::unique_lock<std::mutex> lk(s.mu);
+  const auto ready = [&] { return s.version >= min_version; };
+  if (timeout_ms < 0) {
+    s.cv.wait(lk, ready);
+  } else if (!s.cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                            ready)) {
+    return -1;
+  }
+  return static_cast<int64_t>(s.data.size());
+}
+
+// Copy out a consistent snapshot (caller sized the buffer via _wait).
+// Writes the version to *out_version and returns the ACTUAL byte count
+// copied (a concurrent write may have grown or shrunk the slot since the
+// wait), or -1 if the current value no longer fits the caller's buffer.
+GT_EXPORT int64_t gt_multibuffer_read(void* mb, int64_t slot, uint8_t* out,
+                            int64_t capacity, int64_t* out_version) {
+  Slot& s = static_cast<MultiBuffer*>(mb)->slots[slot];
+  std::lock_guard<std::mutex> lk(s.mu);
+  if (static_cast<int64_t>(s.data.size()) > capacity) return -1;
+  std::memcpy(out, s.data.data(), s.data.size());
+  *out_version = s.version;
+  return static_cast<int64_t>(s.data.size());
+}
+
+GT_EXPORT int64_t gt_multibuffer_version(void* mb, int64_t slot) {
+  Slot& s = static_cast<MultiBuffer*>(mb)->slots[slot];
+  std::lock_guard<std::mutex> lk(s.mu);
+  return s.version;
+}
+
+}  // extern "C"

@@ -1,0 +1,116 @@
+// Work-stealing-free fixed threadpool with a blocking parallel_for.
+//
+// Native-parity counterpart of the reference's pool
+// (pytorch_impl/libs/native/include/threadpool.hpp, 222 LoC mutex/condvar
+// pool with parallel_for at :202) — re-implemented from scratch: a shared
+// pool of hardware_concurrency workers, jobs are [begin, end) index ranges
+// split into contiguous chunks, submitter blocks until completion.
+#pragma once
+
+#include <condition_variable>
+#include <cstddef>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace garfield {
+
+class ThreadPool {
+ public:
+  static ThreadPool& shared() {
+    static ThreadPool pool;
+    return pool;
+  }
+
+  explicit ThreadPool(std::size_t nthreads = 0) {
+    if (nthreads == 0) {
+      nthreads = std::thread::hardware_concurrency();
+      if (nthreads == 0) nthreads = 1;
+    }
+    workers_.reserve(nthreads);
+    for (std::size_t t = 0; t < nthreads; ++t) {
+      workers_.emplace_back([this] { worker_loop(); });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  std::size_t size() const { return workers_.size(); }
+
+  // Run fn(i) for i in [begin, end), splitting the range into one contiguous
+  // chunk per worker; blocks until every index has been processed.
+  void parallel_for(std::size_t begin, std::size_t end,
+                    const std::function<void(std::size_t, std::size_t)>& body) {
+    const std::size_t total = end > begin ? end - begin : 0;
+    if (total == 0) return;
+    const std::size_t nchunks =
+        total < workers_.size() ? total : workers_.size();
+    if (nchunks <= 1) {
+      body(begin, end);
+      return;
+    }
+    const std::size_t chunk = (total + nchunks - 1) / nchunks;
+    // Completion state guarded by done_mu: decrement AND notify happen under
+    // the lock, so the waiter cannot observe pending==0 and destroy these
+    // stack locals while a worker still holds or is about to take the lock.
+    std::size_t pending = nchunks;
+    std::mutex done_mu;
+    std::condition_variable done_cv;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (std::size_t c = 0; c < nchunks; ++c) {
+        const std::size_t lo = begin + c * chunk;
+        const std::size_t hi = lo + chunk < end ? lo + chunk : end;
+        jobs_.push_back([&, lo, hi] {
+          body(lo, hi);
+          std::lock_guard<std::mutex> dlk(done_mu);
+          if (--pending == 0) done_cv.notify_one();
+        });
+      }
+    }
+    cv_.notify_all();
+    std::unique_lock<std::mutex> dlk(done_mu);
+    done_cv.wait(dlk, [&] { return pending == 0; });
+  }
+
+ private:
+  void worker_loop() {
+    for (;;) {
+      std::function<void()> job;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stop_ || !jobs_.empty(); });
+        if (stop_ && jobs_.empty()) return;
+        job = std::move(jobs_.back());
+        jobs_.pop_back();
+      }
+      job();
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<std::function<void()>> jobs_;
+  std::vector<std::thread> workers_;
+  bool stop_ = false;
+};
+
+// Convenience: parallel loop over single indices.
+inline void parallel_for_each(
+    std::size_t begin, std::size_t end,
+    const std::function<void(std::size_t)>& fn) {
+  ThreadPool::shared().parallel_for(
+      begin, end, [&](std::size_t lo, std::size_t hi) {
+        for (std::size_t i = lo; i < hi; ++i) fn(i);
+      });
+}
+
+}  // namespace garfield

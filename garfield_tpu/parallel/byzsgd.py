@@ -64,6 +64,7 @@ def make_trainer(
     ps_axis="ps",
     subset=None,
     model_gar=None,
+    granularity="model",
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the MSMW topology.
 
@@ -71,6 +72,11 @@ def make_trainer(
     (default: same rule) aggregates server models with tolerance ``fps`` —
     the reference uses one GAR for both (ByzSGD/trainer.py:34 note).
     ``subset=q`` gives each PS its own sampled wait-for-q gradient subset.
+    ``granularity="layer"`` applies both GARs independently per parameter
+    tensor — the Garfield_CC GuanYu semantics (its reduce_gradients loops
+    over model layers, Garfield_CC/trainer.py:55-204) — by segmenting the
+    flat stacks at the (static) parameter boundaries; attacks still act on
+    the whole flat vector.
 
     ``step_fn(state, x, y)``: ``x``/``y`` lead with ``num_workers`` sharded
     over ``axis``; state params/opt_state lead with ``num_ps`` sharded over
@@ -134,7 +140,13 @@ def make_trainer(
                 jax.random.fold_in(sub_key, ps_id), n, subset
             )
             stack = stack[sel]
-        aggr = gar.unchecked(stack, f=fw)
+        if granularity == "layer":
+            aggr = core.segmented_aggregate(
+                lambda s: gar.unchecked(s, f=fw), stack,
+                core.leaf_segments(params),
+            )
+        else:
+            aggr = gar.unchecked(stack, f=fw)
         updates, new_opt = optimizer.update(
             core.unflatten_like(params, aggr), opt_state, params
         )
@@ -200,10 +212,15 @@ def make_trainer(
             )
         )(jnp.arange(num_ps), models)
         models = jnp.where(byz_ps_mask[:, None], poisoned, models)
-        aggr_model = model_gar.unchecked(models, f=fps)
-        written = core.unflatten_like(
-            jax.tree.map(lambda l: l[0], new_params), aggr_model
-        )
+        params0 = jax.tree.map(lambda l: l[0], new_params)
+        if granularity == "layer":
+            aggr_model = core.segmented_aggregate(
+                lambda s: model_gar.unchecked(s, f=fps), models,
+                core.leaf_segments(params0),
+            )
+        else:
+            aggr_model = model_gar.unchecked(models, f=fps)
+        written = core.unflatten_like(params0, aggr_model)
         new_params = jax.tree.map(
             lambda l: jnp.broadcast_to(l[None], (per_ps,) + l.shape), written
         )

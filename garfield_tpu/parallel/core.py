@@ -114,6 +114,32 @@ def unflatten_like(template_tree, flat_vec):
     return unravel(flat_vec)
 
 
+def leaf_segments(tree):
+    """Static (start, end) column spans of each leaf in ravel order.
+
+    ``ravel_pytree`` concatenates leaves in ``jax.tree.leaves`` order, so a
+    flat (n, d) stack can be sliced back into per-parameter blocks — the
+    basis for per-layer GAR granularity (Garfield_CC/trainer.py:55-204 loops
+    over ``model.parameters()``).
+    """
+    import numpy as np
+
+    spans, start = [], 0
+    for leaf in jax.tree.leaves(tree):
+        size = int(np.prod(jnp.shape(leaf))) if jnp.ndim(leaf) else 1
+        spans.append((start, start + size))
+        start += size
+    return spans
+
+
+def segmented_aggregate(agg_fn, stack, segments):
+    """Apply ``agg_fn`` independently to each column segment of an (n, d)
+    stack and concatenate — per-layer aggregation over a flat stack."""
+    return jnp.concatenate(
+        [agg_fn(stack[:, s:e]) for s, e in segments], axis=0
+    )
+
+
 def subset_indices(key, n, q):
     """Uniformly sample q of n row indices (static shape (q,)).
 

@@ -1,0 +1,170 @@
+"""Multi-host (DCN) scaffolding: jax.distributed init, cluster config, and
+host-level fault simulation.
+
+Counterparts:
+  - cluster config: the TF_CONFIG-style JSON cluster files of the TF impl —
+    host lists + per-task {type, index} plus Garfield extras (GAR, attacks)
+    — parsed by ``Network`` (tensorflow_impl/rsrcs/network.py:36-89) and
+    written interactively by each app's ``config_generator.py`` (:30-90);
+  - process bootstrap: ``dist.init_process_group`` / ``rpc.init_rpc``
+    (Garfield_CC/trainer.py:367-380, Aggregathor/trainer.py:217-224) ->
+    ``jax.distributed.initialize`` (one controller per host, collectives ride
+    ICI within a slice and DCN across);
+  - failure simulation: the reference has no failure detector — resilience is
+    wait-n-f (SURVEY §5). On a bulk-synchronous mesh, a crashed/straggling
+    host cannot simply be absent, so ``FaultSchedule`` turns host-level
+    crash/straggler scenarios into per-step value faults: crashed hosts'
+    worker slots join the Byzantine mask (their gradient rows become zeros —
+    exactly what Garfield_CC's ``mar='crash'`` mode feeds the model GAR,
+    trainer.py:97,137) and the wait-n-f ``subset`` knob models which peers
+    answered in time.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from . import tools
+
+__all__ = [
+    "ClusterConfig",
+    "generate_config",
+    "init_distributed",
+    "FaultSchedule",
+]
+
+
+class ClusterConfig:
+    """JSON cluster spec: {"cluster": {"worker": [hosts], "ps": [hosts]},
+    "task": {"type": "worker", "index": 0}, "garfield": {...}}.
+
+    The shape mirrors TF_CONFIG (tensorflow_impl/README.md:46-96) so existing
+    Garfield deployment tooling maps 1:1; the "garfield" section carries the
+    per-run parameters the reference spreads over CLI flags.
+    """
+
+    def __init__(self, spec):
+        if isinstance(spec, (str, os.PathLike)):
+            with open(spec) as fp:
+                spec = json.load(fp)
+        self.spec = dict(spec)
+        cluster = self.spec.get("cluster", {})
+        self.workers = list(cluster.get("worker", []))
+        self.ps = list(cluster.get("ps", []))
+        task = self.spec.get("task", {"type": "worker", "index": 0})
+        self.task_type = task.get("type", "worker")
+        self.task_index = int(task.get("index", 0))
+        self.garfield = dict(self.spec.get("garfield", {}))
+
+    @classmethod
+    def from_env(cls, var="GARFIELD_CONFIG"):
+        """Load from the env var (path or inline JSON), like TF_CONFIG."""
+        raw = os.environ.get(var)
+        if not raw:
+            return None
+        if raw.lstrip().startswith("{"):
+            return cls(json.loads(raw))
+        return cls(raw)
+
+    @property
+    def hosts(self):
+        return self.ps + self.workers
+
+    @property
+    def num_processes(self):
+        return len(self.hosts)
+
+    @property
+    def process_id(self):
+        base = 0 if self.task_type == "ps" else len(self.ps)
+        return base + self.task_index
+
+    @property
+    def coordinator(self):
+        """First host (the reference's --master / rank-0 convention)."""
+        return self.hosts[0] if self.hosts else None
+
+
+def generate_config(path, *, workers, ps=(), task_type="worker", task_index=0,
+                    **garfield):
+    """Write a cluster config JSON (config_generator.py:30-90 counterpart,
+    non-interactive)."""
+    spec = {
+        "cluster": {"worker": list(workers), "ps": list(ps)},
+        "task": {"type": task_type, "index": task_index},
+        "garfield": garfield,
+    }
+    with open(path, "w") as fp:
+        json.dump(spec, fp, indent=1)
+    return spec
+
+
+def init_distributed(config=None, **overrides):
+    """Initialize jax.distributed from a ClusterConfig / env / overrides.
+
+    No-op on single-process runs (coordinator is None and no env setup).
+    Returns (num_processes, process_id).
+    """
+    import jax
+
+    if config is None:
+        config = ClusterConfig.from_env()
+    kwargs = {}
+    if config is not None and config.coordinator:
+        kwargs = dict(
+            coordinator_address=config.coordinator,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+    kwargs.update(overrides)
+    if not kwargs:
+        return 1, 0
+    jax.distributed.initialize(**kwargs)
+    tools.info(
+        f"[multihost] initialized process "
+        f"{jax.process_index()}/{jax.process_count()}"
+    )
+    return jax.process_count(), jax.process_index()
+
+
+class FaultSchedule:
+    """Deterministic host-level crash/straggler plan -> per-step value faults.
+
+    ``crashes`` maps host_id -> step at which it dies; ``stragglers`` maps
+    host_id -> probability its contribution misses the wait-n-f cut.
+    ``byz_mask(step, num_workers, hosts)`` returns the mask of worker slots
+    whose rows must be zeroed this step (dead hosts); ``subset(step, n, f)``
+    returns the wait-for-q value emulating stragglers (q = n - #suspected).
+    Seeded: replayable across the whole fleet without coordination.
+    """
+
+    def __init__(self, num_hosts, *, crashes=None, stragglers=None, seed=1234):
+        self.num_hosts = int(num_hosts)
+        self.crashes = dict(crashes or {})
+        self.stragglers = dict(stragglers or {})
+        self.seed = seed
+
+    def dead_hosts(self, step):
+        return {h for h, at in self.crashes.items() if step >= at}
+
+    def byz_mask(self, step, num_workers, *, base_mask=None):
+        """Worker slots on dead hosts (slots split evenly across hosts)."""
+        mask = (
+            np.zeros(num_workers, bool)
+            if base_mask is None else np.asarray(base_mask, bool).copy()
+        )
+        per_host = num_workers // self.num_hosts
+        for h in self.dead_hosts(step):
+            mask[h * per_host : (h + 1) * per_host] = True
+        return mask
+
+    def subset(self, step, n, f):
+        """q for the wait-n-f path this step: full minus suspected laggards,
+        never below n - f (the tolerance budget)."""
+        rng = np.random.default_rng((self.seed, step))
+        slow = sum(
+            1 for h, prob in self.stragglers.items()
+            if h not in self.dead_hosts(step) and rng.random() < prob
+        )
+        return max(n - f, n - slow)

@@ -1,0 +1,105 @@
+"""Step timing, XLA profiler traces, and bandwidth accounting.
+
+Counterpart of the reference's opt-in instrumentation (SURVEY §5):
+  - per-step wall time: ``timeit(train_step, number=1)`` prints
+    (Aggregathor/trainer.py:244-247) -> ``StepTimer``;
+  - profiler: ``torch.autograd.profiler.profile(enabled=bench)``
+    (Aggregathor/trainer.py:234-239) -> ``jax.profiler.trace`` (XLA/TPU
+    timeline viewable in TensorBoard/Perfetto);
+  - bandwidth: psutil NIC byte deltas (garfieldpp/tools.py:152-163, printed
+    trainer.py:240-241). A TPU mesh has no NIC counters to poll; collective
+    traffic is fully determined by the program, so we *derive* per-step bytes
+    from the collective shapes instead (``collective_bytes``).
+"""
+
+import contextlib
+import time
+
+import jax
+import numpy as np
+
+__all__ = [
+    "StepTimer",
+    "trace",
+    "collective_bytes",
+    "convert_to_gbit",
+]
+
+
+class StepTimer:
+    """Wall-clock timer that blocks on device results for honest numbers.
+
+    ``with timer.step(): ...`` records one step; ``summary()`` reports
+    count/mean/min/max seconds, like the per-step prints at
+    Aggregathor/trainer.py:244-247 but aggregated.
+    """
+
+    def __init__(self):
+        self.times = []
+
+    @contextlib.contextmanager
+    def step(self, block_on=None):
+        t0 = time.perf_counter()
+        yield
+        if block_on is not None:
+            jax.block_until_ready(block_on)
+        self.times.append(time.perf_counter() - t0)
+
+    def last(self):
+        return self.times[-1] if self.times else float("nan")
+
+    def summary(self):
+        if not self.times:
+            return {"count": 0}
+        a = np.asarray(self.times)
+        return {
+            "count": int(a.size),
+            "mean_s": float(a.mean()),
+            "min_s": float(a.min()),
+            "max_s": float(a.max()),
+            "total_s": float(a.sum()),
+        }
+
+
+@contextlib.contextmanager
+def trace(log_dir=None):
+    """``jax.profiler`` trace scope; no-op when ``log_dir`` is None."""
+    if log_dir is None:
+        yield
+        return
+    with jax.profiler.trace(str(log_dir)):
+        yield
+
+
+def collective_bytes(topology, *, num_workers, d, num_ps=1, rounds=1,
+                     bytes_per_el=4, axis_size=None):
+    """Per-step collective traffic (bytes) implied by the topology's program.
+
+    Replaces NIC-counter polling (garfieldpp/tools.py:152-163): the SPMD
+    program's communication volume is static. Counts the all_gather payloads
+    per device (ring all-gather moves (k-1)/k of the gathered buffer over
+    ICI, k = axis size):
+
+      - aggregathor: one (n_w, d) gradient all_gather           (server.py:112-159)
+      - byzsgd:      + one (n_ps, d) model all_gather           (server.py:161-184)
+      - learn:       gradient gather x (1 + rounds) + model gather
+                                                                (LEARN/trainer.py:208-257)
+    """
+    k = axis_size if axis_size else num_workers
+    frac = (k - 1) / k if k > 1 else 0.0
+    grad_gather = num_workers * d * bytes_per_el * frac
+    model_gather = num_ps * d * bytes_per_el * frac
+    if topology in ("centralized",):
+        return 0
+    if topology in ("aggregathor", "garfield_cc"):
+        return int(grad_gather)
+    if topology == "byzsgd":
+        return int(grad_gather + model_gather)
+    if topology == "learn":
+        return int(grad_gather * (1 + rounds) + num_workers * d * bytes_per_el * frac)
+    raise ValueError(f"unknown topology {topology!r}")
+
+
+def convert_to_gbit(num_bytes):
+    """Bytes -> Gbit (garfieldpp/tools.py:161-163)."""
+    return num_bytes * 8 / (1024 ** 3)

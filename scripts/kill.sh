@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Stop a fanned-out experiment on every host.
+# Counterpart of the reference's per-app kill.sh (ssh + pkill loops).
+#
+# Usage: scripts/kill.sh <hosts_file>
+set -euo pipefail
+
+HOSTS_FILE=${1:?hosts file}
+mapfile -t HOSTS < <(grep -v '^#' "$HOSTS_FILE" | sed '/^$/d')
+for entry in "${HOSTS[@]}"; do
+  HOST=${entry%%:*}
+  ssh -o StrictHostKeyChecking=no "$HOST" \
+    "pkill -f 'garfield_tpu.apps' || true" &
+done
+wait
+echo "killed garfield_tpu processes on ${#HOSTS[@]} hosts"

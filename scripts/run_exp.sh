@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Multi-host experiment fan-out.
+#
+# Counterpart of the reference's per-app run_exp.sh (ssh loops over
+# `servers`/`workers` host files, Aggregathor/run_exp.sh:41-60). One host =
+# one JAX process (multi-controller); the coordinator is the first host in
+# the hosts file, mirroring the reference's rank-0 --master convention.
+#
+# Usage:
+#   scripts/run_exp.sh <hosts_file> <app> [app args...]
+# e.g.
+#   scripts/run_exp.sh nodes aggregathor --dataset cifar10 --model resnet18 \
+#       --num_workers 8 --fw 2 --gar krum --attack lie
+#
+# Each line of <hosts_file> is "host[:port]". Requires passwordless ssh and
+# this repo at the same path on every host (Grid5000/vagrant style,
+# pytorch_impl/README.md:63-67).
+set -euo pipefail
+
+HOSTS_FILE=${1:?hosts file}
+APP=${2:?app name (centralized|aggregathor|byzsgd|learn|garfield_cc)}
+shift 2
+
+mapfile -t HOSTS < <(grep -v '^#' "$HOSTS_FILE" | sed '/^$/d')
+NUM=${#HOSTS[@]}
+COORD=${HOSTS[0]}
+[[ "$COORD" == *:* ]] || COORD="$COORD:9900"
+REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
+
+# Shell-quote the app args so JSON/space-containing values (--opt_args
+# '{"lr":"0.2"}') survive the remote shell's word splitting.
+APP_ARGS=""
+for arg in "$@"; do
+  APP_ARGS+=$(printf ' %q' "$arg")
+done
+
+echo "launching $APP on $NUM hosts (coordinator $COORD)"
+for i in "${!HOSTS[@]}"; do
+  HOST=${HOSTS[$i]%%:*}
+  CONFIG=$(python3 - "$i" "$NUM" "$COORD" <<'PY'
+import json, sys
+i, num, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+print(json.dumps({
+    "cluster": {"worker": [coord] + [f"host{k}" for k in range(1, num)]},
+    "task": {"type": "worker", "index": i},
+}))
+PY
+)
+  ssh -o StrictHostKeyChecking=no "$HOST" \
+    "cd '$REPO_DIR' && GARFIELD_CONFIG='$CONFIG' \
+     nohup python3 -m garfield_tpu.apps.$APP$APP_ARGS \
+     > run_${APP}_rank${i}.log 2>&1 &" &
+done
+wait
+echo "all ranks launched; logs: run_${APP}_rank*.log on each host"

@@ -4,17 +4,16 @@ This is the fake-backend the reference lacked (SURVEY §4): every distributed
 construct is testable single-process by running the SPMD program over 8
 host-local CPU devices.
 
-Two paths, because jax may already be preloaded (and a TPU PJRT plugin
-registered) by the interpreter's sitecustomize before this file runs:
-  - if jax is not yet imported, plain env vars do the job;
-  - if it is, ``jax.config.update`` still wins as long as no backend has been
-    initialized — it both overrides the platform choice and sets the virtual
-    CPU device count, and keeps the TPU plugin from ever being initialized
-    (its init can block on an unavailable device tunnel).
+The interpreter's sitecustomize preloads jax and registers the TPU PJRT
+plugin before this file runs, so env vars alone are too late;
+``jax.config.update`` still wins as long as no backend has been initialized —
+it overrides the platform choice, sets the virtual CPU device count, and
+keeps the TPU plugin from ever being initialized (its init can block on an
+unavailable device tunnel). The env vars are still set for any subprocess a
+test might spawn.
 """
 
 import os
-import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -23,8 +22,16 @@ if "--xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-if "jax" in sys.modules:
-    import jax
+import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+# Persistent compilation cache: CPU test compiles of the large SPMD programs
+# dominate suite time; caching them across runs keeps the suite fast.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.expanduser("~/.cache/garfield_tpu/jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,56 @@
+"""Web-demo test: the de-facto multi-node-on-one-host harness (SURVEY §4
+item 4 — the reference's only distributed test was its demo; ours runs the
+real HTTP server + a real tiny LEARN training)."""
+
+import http.client
+import json
+import threading
+import time
+
+from garfield_tpu.apps import demo
+
+
+def _request(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request(method, path, body=json.dumps(body) if body else None)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_demo_trains_via_http():
+    from http.server import ThreadingHTTPServer
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), demo.Handler)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        status, page = _request(port, "GET", "/")
+        assert status == 200 and b"LEARN" in page
+
+        status, _ = _request(
+            port, "POST", "/train",
+            {"nodes": 4, "f": 1, "gar": "median", "attack": "lie",
+             "epochs": 1},
+        )
+        assert status == 200
+
+        deadline = time.time() + 120
+        final = None
+        while time.time() < deadline:
+            status, data = _request(port, "GET", "/status")
+            final = json.loads(data)
+            assert final.get("error") is None, final
+            if final.get("done"):
+                break
+            time.sleep(0.5)
+        assert final and final.get("done"), f"timed out: {final}"
+        assert 0.0 <= final["accuracy"] <= 1.0
+        assert final["step"] == final["total"]
+
+        status, _ = _request(port, "GET", "/nope")
+        assert status == 404
+    finally:
+        server.shutdown()

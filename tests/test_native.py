@@ -1,0 +1,116 @@
+"""Native (C++) kernel parity tests: elementwise agreement with the jit'd XLA
+rules (the golden suite of SURVEY §4), NaN resilience, jit usability via
+pure_callback, and the MRMW multibuffer register."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from garfield_tpu import aggregators
+
+pytestmark = pytest.mark.skipif(
+    "native-krum" not in aggregators.gars,
+    reason="native toolchain unavailable",
+)
+
+
+def _native():
+    from garfield_tpu import native
+
+    if not native.available():
+        pytest.skip("native build failed")
+    return native
+
+
+def stacks():
+    rng = np.random.default_rng(7)
+    for n, d in [(7, 5), (11, 64), (15, 1), (23, 33)]:
+        yield rng.standard_normal((n, d)).astype(np.float32)
+
+
+@pytest.mark.parametrize("rule,f_of_n", [
+    ("krum", lambda n: (n - 3) // 2),
+    ("median", lambda n: 1),
+    ("bulyan", lambda n: (n - 3) // 4),
+    ("brute", lambda n: min((n - 1) // 2, 3)),
+])
+def test_native_matches_xla(rule, f_of_n):
+    native = _native()
+    for g in stacks():
+        n = g.shape[0]
+        f = f_of_n(n)
+        if f < 1:
+            continue
+        kwargs = {} if rule == "median" else {"f": f}
+        want = np.asarray(aggregators.gars[rule].unchecked(g, **kwargs))
+        got = getattr(native, rule)(g, **kwargs)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_native_median_nan_resilient():
+    native = _native()
+    g = np.array(
+        [[1.0, np.nan], [2.0, 5.0], [3.0, 4.0], [4.0, np.nan], [5.0, 6.0]],
+        dtype=np.float32,
+    )
+    want = np.asarray(aggregators.gars["median"].unchecked(g))
+    got = native.median(g)
+    np.testing.assert_allclose(got, want)
+    assert np.isfinite(got).all()
+
+
+def test_native_krum_excludes_nan_row():
+    native = _native()
+    rng = np.random.default_rng(3)
+    g = rng.standard_normal((9, 16)).astype(np.float32)
+    g[8] = np.nan  # Byzantine row: infinite distances, never selected
+    f = 2
+    want = np.asarray(aggregators.gars["krum"].unchecked(g, f=f))
+    got = native.krum(g, f=f)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+    assert np.isfinite(got).all()
+
+
+def test_native_float64():
+    native = _native()
+    g = np.random.default_rng(5).standard_normal((9, 12))
+    got = native.krum(g, f=2)
+    assert got.dtype == np.float64
+
+
+def test_native_gar_inside_jit():
+    import jax
+    import jax.numpy as jnp
+
+    _native()
+    g = np.random.default_rng(11).standard_normal((9, 8)).astype(np.float32)
+
+    @jax.jit
+    def agg(stack):
+        return aggregators.gars["native-krum"].unchecked(stack, f=2)
+
+    got = np.asarray(agg(jnp.asarray(g)))
+    want = np.asarray(aggregators.gars["krum"].unchecked(g, f=2))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_multibuffer_blocking_handoff():
+    native = _native()
+    mb = native.MultiBuffer(2)
+    assert mb.version(0) == 0
+    got = {}
+
+    def reader():
+        got["v"], got["data"] = mb.read(0, min_version=2)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    mb.write(0, b"first")
+    mb.write(0, b"second")  # last-writer-wins register
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert got["v"] == 2 and got["data"] == b"second"
+    with pytest.raises(TimeoutError):
+        mb.read(1, min_version=1, timeout_ms=50)
+    mb.close()
