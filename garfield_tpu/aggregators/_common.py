@@ -66,9 +66,11 @@ def coordinate_median(g):
     torch's ``stack(g).median(dim=0)[0]`` semantics (median.py:39): for even n
     the smaller middle element (index (n-1)//2 of the sorted column), and NaN
     values sort last so up to ceil(n/2)-1 NaN entries per coordinate do not
-    contaminate the result.
+    contaminate the result. Dispatches to the Pallas TPU kernel
+    (garfield_tpu.ops) on TPU; jnp sort elsewhere.
     """
-    n = g.shape[0]
-    return jnp.sort(g, axis=0)[(n - 1) // 2]
+    from .. import ops
+
+    return ops.coordinate_median(g)
 
 

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from . import register
-from ._common import as_stack, coordinate_median, num_gradients, pairwise_distances
+from ._common import as_stack, num_gradients, pairwise_distances
 
 
 def aggregate(gradients, f, m=None, **kwargs):
@@ -63,12 +63,12 @@ def aggregate(gradients, f, m=None, **kwargs):
     selected0 = jnp.zeros((rounds, d), dtype=g.dtype)
     _, selected = jax.lax.fori_loop(0, rounds, round_body, (active0, selected0))
 
-    # Coordinate-wise averaged median (bulyan.py:77-84).
+    # Coordinate-wise averaged median (bulyan.py:77-84); fused Pallas kernel
+    # on TPU (garfield_tpu/ops/coordinate.py), jnp sort+argsort+gather else.
+    from .. import ops
+
     beta = rounds - 2 * f
-    med = coordinate_median(selected)
-    dev = jnp.abs(selected - med[None, :])
-    idx = jnp.argsort(dev, axis=0)[:beta]
-    return jnp.mean(jnp.take_along_axis(selected, idx, axis=0), axis=0)
+    return ops.averaged_median_mean(selected, beta)
 
 
 def check(gradients, f, m=None, **kwargs):

@@ -1,0 +1,168 @@
+"""Pallas TPU kernels for coordinate-wise robust statistics.
+
+Two kernels, mirroring the two CUDA kernels the reference dedicates to this
+layer (SURVEY P13):
+
+  - ``coordinate_median``: lower coordinate-wise median of an (n, d) stack
+    (py_median/median.cu counterpart). torch semantics: for even n the lower
+    of the two middle values; NaN sorts last, so up to ceil(n/2)-1 NaNs per
+    coordinate do not contaminate the result (median.py:39).
+  - ``averaged_median_mean``: Bulyan's second phase (py_bulyan/bulyan.cu
+    counterpart, bulyan.py:77-84): per coordinate, take the beta values
+    closest to the lower median (stable ties: lowest row index wins) and
+    average them. Fused into one kernel so the (s, d) stack is read from HBM
+    exactly once; the jnp fallback needs a sort, an argsort and a gather.
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+  - n is tiny (worker count, <= MAX_SORT_N) and d is huge, so the kernel
+    grid tiles d in LANE-multiple blocks and each program fully sorts its
+    (n, TILE) block with an odd-even transposition network unrolled at trace
+    time. Compare-exchange on strict ``<`` keeps the network STABLE, which
+    is what makes tie-breaking match ``jnp.argsort(..., stable=True)``.
+  - The comparator implements the jnp/torch sort total order for floats:
+    ascending with NaN last — swap iff (b < a) or (a is NaN and b is not).
+  - d is padded to a TILE multiple host-side; columns are independent so the
+    pad values are irrelevant and sliced off.
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Largest stack the sorting-network kernels accept: the unrolled network is
+# O(n^2) vector ops per tile, which is fine for realistic worker counts
+# (the reference's own GAR bench sweeps n <= 512 but runs Byzantine configs
+# at n <= a few dozen) and keeps compile times bounded.
+MAX_SORT_N = 32
+
+_LANES = 128
+_TILE = 1024  # lanes per program: 32 rows x 1024 x 4 B = 128 KiB of VMEM
+
+
+def use_pallas(n=None):
+    """True when the Pallas path should be used (TPU backend, n in range)."""
+    if os.environ.get("GARFIELD_NO_PALLAS"):
+        return False
+    if n is not None and n > MAX_SORT_N:
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _swap_mask(a, b):
+    """Swap iff a must sort after b: ascending, NaN last (strict => stable)."""
+    return (b < a) | (jnp.isnan(a) & ~jnp.isnan(b))
+
+
+def _oddeven_exchange(keys, payloads=None):
+    """In-place-style odd-even transposition sort of a list of row vectors.
+
+    Sorts ``keys`` (list of n equal-shape arrays) ascending under the
+    NaN-last total order; ``payloads`` (optional parallel list) is permuted
+    identically. Unrolled: n rounds of adjacent compare-exchange.
+    """
+    n = len(keys)
+    keys = list(keys)
+    payloads = list(payloads) if payloads is not None else None
+    for rnd in range(n):
+        for i in range(rnd % 2, n - 1, 2):
+            m = _swap_mask(keys[i], keys[i + 1])
+            keys[i], keys[i + 1] = (
+                jnp.where(m, keys[i + 1], keys[i]),
+                jnp.where(m, keys[i], keys[i + 1]),
+            )
+            if payloads is not None:
+                payloads[i], payloads[i + 1] = (
+                    jnp.where(m, payloads[i + 1], payloads[i]),
+                    jnp.where(m, payloads[i], payloads[i + 1]),
+                )
+    return keys if payloads is None else (keys, payloads)
+
+
+def _pad_cols(g, tile):
+    d = g.shape[-1]
+    pad = (-d) % tile
+    if pad:
+        g = jnp.pad(g, ((0, 0), (0, pad)))
+    return g, d
+
+
+def _median_kernel(n, x_ref, o_ref):
+    rows = [x_ref[i, :] for i in range(n)]
+    rows = _oddeven_exchange(rows)
+    o_ref[0, :] = rows[(n - 1) // 2]
+
+
+def _avgmed_kernel(s, beta, x_ref, o_ref):
+    vals = [x_ref[i, :] for i in range(s)]
+    med = _oddeven_exchange(list(vals))[(s - 1) // 2]
+    devs = [jnp.abs(v - med) for v in vals]
+    _, picked = _oddeven_exchange(devs, vals)
+    acc = picked[0]
+    for i in range(1, beta):
+        acc = acc + picked[i]
+    o_ref[0, :] = acc / beta
+
+
+def _column_call(kernel, g, tile, interpret):
+    """Run a (n, TILE) -> (1, TILE) kernel over d-tiles of g."""
+    if tile % _LANES:
+        raise ValueError(f"tile must be a multiple of {_LANES}, got {tile}")
+    g, d = _pad_cols(g, tile)
+    n, dp = g.shape
+    out = pl.pallas_call(
+        kernel,
+        grid=(dp // tile,),
+        in_specs=[pl.BlockSpec((n, tile), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((1, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, dp), g.dtype),
+        interpret=interpret,
+    )(g)
+    return out[0, :d]
+
+
+# --- public entry points ---------------------------------------------------
+
+
+def coordinate_median_reference(g):
+    """jnp spec: lower coordinate-wise median, NaN-resilient (median.py:39)."""
+    n = g.shape[0]
+    return jnp.sort(g, axis=0)[(n - 1) // 2]
+
+
+def averaged_median_mean_reference(g, beta):
+    """jnp spec for Bulyan phase 2 (bulyan.py:77-84)."""
+    med = coordinate_median_reference(g)
+    dev = jnp.abs(g - med[None, :])
+    idx = jnp.argsort(dev, axis=0, stable=True)[:beta]
+    return jnp.mean(jnp.take_along_axis(g, idx, axis=0), axis=0)
+
+
+def coordinate_median(g, *, interpret=False, tile=_TILE):
+    """Lower coordinate-wise median of an (n, d) stack -> (d,)."""
+    g = jnp.asarray(g)
+    n = g.shape[0]
+    if not interpret and not use_pallas(n):
+        return coordinate_median_reference(g)
+    if n == 1:
+        return g[0]
+    kernel = functools.partial(_median_kernel, n)
+    return _column_call(kernel, g, tile, interpret)
+
+
+def averaged_median_mean(g, beta, *, interpret=False, tile=_TILE):
+    """Mean of the beta rows closest (per coordinate) to the lower median.
+
+    Equivalent to ``averaged_median_mean_reference`` (ties broken stably by
+    row index, NaN deviations sort last) but fused into a single HBM pass.
+    """
+    g = jnp.asarray(g)
+    s = g.shape[0]
+    if not (1 <= beta <= s):
+        raise ValueError(f"beta must be in [1, {s}], got {beta}")
+    if not interpret and not use_pallas(s):
+        return averaged_median_mean_reference(g, beta)
+    kernel = functools.partial(_avgmed_kernel, s, beta)
+    return _column_call(kernel, g, tile, interpret)

@@ -1,0 +1,90 @@
+"""Pallas coordinate-kernel tests (interpret mode on the CPU test mesh).
+
+The jnp reference implementations in garfield_tpu/ops/coordinate.py ARE the
+spec (they reproduce the torch semantics of the reference's median.py:39 and
+bulyan.py:77-84); the kernels must match them bit-for-bit, including NaN
+placement and stable tie-breaking.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from garfield_tpu.ops import coordinate
+
+
+def _rand(n, d, seed, nan_frac=0.0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    if nan_frac:
+        mask = rng.random((n, d)) < nan_frac
+        # never a full-NaN column beyond what median tolerates
+        mask[0] = False
+        x = np.where(mask, np.nan, x)
+    return x
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 8, 9, 15])
+@pytest.mark.parametrize("d", [1, 64, 130, 1024])
+def test_median_matches_reference(n, d):
+    x = _rand(n, d, seed=n * 1000 + d)
+    got = coordinate.coordinate_median(x, interpret=True, tile=128)
+    want = coordinate.coordinate_median_reference(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_median_nan_resilient():
+    x = _rand(9, 257, seed=7, nan_frac=0.2)
+    got = coordinate.coordinate_median(x, interpret=True, tile=128)
+    want = coordinate.coordinate_median_reference(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_median_even_n_takes_lower():
+    x = np.array([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0], [4.0, 40.0]], np.float32)
+    got = coordinate.coordinate_median(x, interpret=True, tile=128)
+    np.testing.assert_array_equal(np.asarray(got), [2.0, 20.0])
+
+
+@pytest.mark.parametrize("s,beta", [(3, 1), (5, 3), (8, 4), (9, 9), (11, 5)])
+def test_averaged_median_mean_matches_reference(s, beta):
+    x = _rand(s, 300, seed=s * 31 + beta)
+    got = coordinate.averaged_median_mean(x, beta, interpret=True, tile=128)
+    want = coordinate.averaged_median_mean_reference(jnp.asarray(x), beta)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_averaged_median_mean_stable_ties():
+    # Rows 0 and 2 are equidistant from the median; stable argsort must pick
+    # the lower row index. Any unstable sort averages a different pair.
+    x = np.array([[0.0], [1.0], [2.0], [5.0]], np.float32)  # median = 1.0
+    got = coordinate.averaged_median_mean(x, 2, interpret=True, tile=128)
+    want = coordinate.averaged_median_mean_reference(jnp.asarray(x), 2)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(got), [0.5])  # rows 1 then 0
+
+
+def test_averaged_median_mean_nan():
+    x = _rand(7, 140, seed=3, nan_frac=0.15)
+    got = coordinate.averaged_median_mean(x, 3, interpret=True, tile=128)
+    want = coordinate.averaged_median_mean_reference(jnp.asarray(x), 3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_beta_bounds():
+    x = _rand(4, 8, seed=0)
+    with pytest.raises(ValueError):
+        coordinate.averaged_median_mean(x, 0, interpret=True)
+    with pytest.raises(ValueError):
+        coordinate.averaged_median_mean(x, 5, interpret=True)
+
+
+def test_dispatch_falls_back_off_tpu():
+    # On the CPU test backend use_pallas() is False: public wrappers must
+    # route to the jnp reference and still be correct.
+    assert not coordinate.use_pallas()
+    x = _rand(6, 50, seed=11)
+    np.testing.assert_array_equal(
+        np.asarray(coordinate.coordinate_median(x)),
+        np.asarray(coordinate.coordinate_median_reference(jnp.asarray(x))),
+    )
