@@ -44,8 +44,14 @@ def aggregate(gradients, f, m=None, **kwargs):
     rounds = n - 2 * f - 2
     dist = pairwise_distances(g)  # (n, n), diag/non-finite -> +inf
 
+    # The selection loop only needs the (n, n) distance matrix: each round
+    # scores the active nodes, records the Multi-Krum selection *weights*
+    # (1/m_i on the m_i best, 0 elsewhere), and prunes the best node. The
+    # selected averages are then ONE (rounds, n) @ (n, d) matmul after the
+    # loop — the loop never touches the d-sized stack, so the whole phase
+    # costs a single MXU pass over g instead of rounds x (gather + cumsum).
     def round_body(i, carry):
-        active, selected = carry
+        active, weights = carry
         m_i = jnp.minimum(m, m_max - i)
         pair_ok = active[:, None] & active[None, :]
         masked = jnp.where(pair_ok, dist, jnp.inf)
@@ -53,15 +59,21 @@ def aggregate(gradients, f, m=None, **kwargs):
         scores = jax.lax.dynamic_index_in_dim(csum, m_i - 1, axis=1, keepdims=False)
         scores = jnp.where(active, scores, jnp.inf)
         order = jnp.argsort(scores)  # stable: ties break on lowest index
-        gcum = jnp.cumsum(g[order], axis=0)
-        avg = jax.lax.dynamic_index_in_dim(gcum, m_i - 1, axis=0, keepdims=False)
-        selected = selected.at[i].set(avg / m_i)
+        w = jnp.zeros((n,), g.dtype).at[order].set(
+            (jnp.arange(n) < m_i).astype(g.dtype) / m_i
+        )
+        weights = weights.at[i].set(w)
         active = active.at[order[0]].set(False)
-        return active, selected
+        return active, weights
 
     active0 = jnp.ones((n,), dtype=bool)
-    selected0 = jnp.zeros((rounds, d), dtype=g.dtype)
-    _, selected = jax.lax.fori_loop(0, rounds, round_body, (active0, selected0))
+    weights0 = jnp.zeros((rounds, n), dtype=g.dtype)
+    _, weights = jax.lax.fori_loop(0, rounds, round_body, (active0, weights0))
+    # Rows never selected in any round must not poison the matmul with
+    # NaN/Inf coordinates (0 * inf = nan); rows that are selected pass
+    # through untouched (reference mean semantics).
+    used = jnp.any(weights != 0, axis=0)
+    selected = weights @ jnp.where(used[:, None], g, 0)  # (rounds, d)
 
     # Coordinate-wise averaged median (bulyan.py:77-84); fused Pallas kernel
     # on TPU (garfield_tpu/ops/coordinate.py), jnp sort+argsort+gather else.

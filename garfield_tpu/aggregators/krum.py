@@ -34,13 +34,25 @@ def selection_indices(gradients, f, m=None):
 
 
 def aggregate(gradients, f, m=None, **kwargs):
-    """Multi-Krum: average of the m best-scored gradients."""
+    """Multi-Krum: average of the m best-scored gradients.
+
+    The average is computed as a one-hot weight matvec ``w @ g`` rather than
+    ``mean(g[sel])``: the dynamic gather materializes an (m, d) copy before
+    reducing, while the masked matvec lets XLA fuse the zero-guard into the
+    dot's operand read — measured 1.2x (n=8) to 1.8x (n=16) faster at
+    d = 11.2M on a real chip (PERF.md).
+    """
     g = as_stack(gradients)
     n = g.shape[0]
     if m is None:
         m = n - f - 2
     sel = selection_indices(g, f, m)
-    return jnp.mean(g[sel], axis=0)
+    w = jnp.zeros((n,), g.dtype).at[sel].set(1.0 / m)
+    # Zero-weight rows must not poison the matvec with NaN/Inf coordinates
+    # (0 * inf = nan); selected rows pass through untouched, preserving the
+    # reference's mean(g[sel]) semantics exactly.
+    gz = jnp.where((w != 0)[:, None], g, 0)
+    return w @ gz
 
 
 def check(gradients, f, m=None, **kwargs):

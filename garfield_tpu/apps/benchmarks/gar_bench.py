@@ -1,9 +1,10 @@
 """GAR kernel latency sweep.
 
 Counterpart of ``pytorch_impl/applications/benchmarks/gar_bench.py``
-(:41-89): per-GAR median latency across n in powers of two, f as allowed by
-each rule's contract, d in powers of ten — the same sweep grid, but timed as
-jit'd XLA executions (compile excluded, device-synchronized) and, for the
+(:41-89): per-GAR latency across n in powers of two, f as allowed by each
+rule's contract, d in powers of ten — the same sweep grid, but timed as
+jit'd XLA executions (compile excluded) with dependency-chained paired-reps
+timing (see ``bench_one``; JSON key ``latency_s``) and, for the
 ``native-*`` rules, as C++ host kernels.
 
   python -m garfield_tpu.apps.benchmarks.gar_bench --gars krum median \\
@@ -12,7 +13,6 @@ jit'd XLA executions (compile excluded, device-synchronized) and, for the
 
 import argparse
 import json
-import statistics
 import sys
 import time
 
@@ -50,15 +50,36 @@ def bench_one(gar, n, f, d, reps, key):
             return None
     except TypeError:
         pass
-    fn = jax.jit(lambda s: gar.unchecked(s, **kwargs))
-    out = fn(g)
-    jax.block_until_ready(out)  # compile
-    times = []
-    for _ in range(reps):
+    # Timing that survives tunneled/remote device backends, where
+    # ``block_until_ready`` may return before the device finishes and the
+    # only true synchronization is a host readback that also flushes the
+    # queue at a large constant cost:
+    #   - dependency-chain the iterations ((n, d) -> (n, d) by writing the
+    #     aggregate back into row 0) so they cannot be overlapped;
+    #   - run the chain at ``reps`` and ``2*reps`` with a readback sync each,
+    #     and report the difference / reps — the per-sync constant cancels.
+    # The chain input is donated so the row-0 write updates the buffer in
+    # place instead of copying the whole (n, d) stack every iteration (which
+    # would bias cheap rules); each timed run starts from a fresh device
+    # buffer because donation consumes the previous one.
+    chain = jax.jit(
+        lambda s: s.at[0].set(gar.unchecked(s, **kwargs).astype(s.dtype)),
+        donate_argnums=0,
+    )
+    s0_host = np.asarray(chain(g))  # compile + warm + sync (g donated)
+
+    def timed(k):
+        s = jnp.asarray(s0_host)
+        np.asarray(s[0, :1])  # finish H2D transfer + drain queue
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(g))
-        times.append(time.perf_counter() - t0)
-    return statistics.median(times)
+        for _ in range(k):
+            s = chain(s)
+        np.asarray(s[0, :1])  # host readback: the only reliable sync
+        return time.perf_counter() - t0
+
+    t1 = timed(reps)
+    t2 = timed(2 * reps)
+    return max((t2 - t1) / reps, 1e-9)
 
 
 def main(argv=None):
@@ -94,7 +115,7 @@ def main(argv=None):
                 if latency is None:
                     continue
                 row = {"gar": name, "n": n, "f": f, "d": d,
-                       "median_s": latency}
+                       "latency_s": latency}
                 results.append(row)
                 print(f"{name:>16} n={n:<4} f={f:<3} d={d:<7} "
                       f"{latency * 1e3:8.3f} ms", flush=True)

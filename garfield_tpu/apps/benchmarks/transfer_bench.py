@@ -12,12 +12,12 @@ mesh sizes — the ICI-bandwidth number that bounds every topology's step.
 
 import argparse
 import json
-import statistics
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ...parallel import mesh as mesh_lib
@@ -28,20 +28,36 @@ def bench_gather(mesh, d, reps):
     axis = mesh.axis_names[0]
     k = mesh.shape[axis]
 
-    def gather(x_local):
-        return jax.lax.all_gather(x_local, axis, tiled=False)
+    # Dependency-chained paired-reps timing (see gar_bench.bench_one): each
+    # iteration all_gathers, then takes its OWN chunk back out of the
+    # gathered stack so the next iteration depends on the collective without
+    # adding a k*d reduction to the measured span (the fold reads d elements,
+    # 1/k of the gather payload, so the bandwidth number stays honest).
+    def gather_fold(x_local):
+        gathered = jax.lax.all_gather(x_local, axis, tiled=False)
+        return jax.lax.dynamic_index_in_dim(
+            gathered, jax.lax.axis_index(axis), axis=0, keepdims=False
+        )
 
     fn = jax.jit(
-        jax.shard_map(gather, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+        jax.shard_map(
+            gather_fold, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+        )
     )
-    x = jnp.zeros((k, d), jnp.float32)
-    jax.block_until_ready(fn(x))
-    times = []
-    for _ in range(reps):
+    x0 = fn(jnp.zeros((k, d), jnp.float32))
+    np.asarray(x0[0, :1])  # compile + warm + drain queue
+
+    def timed(m):
+        x = x0
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(x))
-        times.append(time.perf_counter() - t0)
-    return statistics.median(times)
+        for _ in range(m):
+            x = fn(x)
+        np.asarray(x[0, :1])
+        return time.perf_counter() - t0
+
+    t1 = timed(reps)
+    t2 = timed(2 * reps)
+    return max((t2 - t1) / reps, 1e-9)
 
 
 def main(argv=None):
@@ -61,7 +77,7 @@ def main(argv=None):
             latency = bench_gather(mesh, d, args.reps)
             payload = k * d * 4
             row = {
-                "devices": k, "d": d, "median_s": latency,
+                "devices": k, "d": d, "latency_s": latency,
                 "gather_gbit": profiling.convert_to_gbit(payload),
                 "gbit_per_s": profiling.convert_to_gbit(payload) / latency,
             }
