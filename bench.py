@@ -29,7 +29,7 @@ def main():
 
     from garfield_tpu import models
     from garfield_tpu.parallel import aggregathor, mesh as mesh_lib
-    from garfield_tpu.utils import selectors
+    from garfield_tpu.utils import profiling, selectors
 
     num_workers = int(os.environ.get("GARFIELD_BENCH_WORKERS", 8))
     f = int(os.environ.get("GARFIELD_BENCH_F", 2))
@@ -70,19 +70,22 @@ def main():
     # backends block_until_ready can return before the device finishes; a
     # readback is the only reliable sync, at a constant queue-flush cost)
 
-    def timed(k, state):
+    state_box = [state]
+
+    def timed(k):
+        state = state_box[0]
         t0 = time.perf_counter()
         for _ in range(k):
             state, metrics = step_fn(state, x, y)
         float(metrics["loss"])
-        return time.perf_counter() - t0, state
+        state_box[0] = state
+        return time.perf_counter() - t0
 
-    # Paired-reps timing: the constant sync cost cancels in the difference.
-    t1, state = timed(steps, state)
-    t2, state = timed(2 * steps, state)
-    dt = max(t2 - t1, 1e-9)
+    # Paired-reps timing: the constant sync cost cancels in the difference
+    # (utils/profiling.paired_reps; see PERF.md "Timing methodology").
+    dt = profiling.paired_reps(timed, steps)
 
-    steps_per_sec_per_chip = steps / dt / axis_size
+    steps_per_sec_per_chip = 1.0 / dt / axis_size
     baseline = None
     try:
         with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as fp:

@@ -39,8 +39,8 @@ def aggregate(gradients, f, m=None, **kwargs):
     The average is computed as a one-hot weight matvec ``w @ g`` rather than
     ``mean(g[sel])``: the dynamic gather materializes an (m, d) copy before
     reducing, while the masked matvec lets XLA fuse the zero-guard into the
-    dot's operand read — measured 1.2x (n=8) to 1.8x (n=16) faster at
-    d = 11.2M on a real chip (PERF.md).
+    dot's operand read — measured ~1.5x faster at n=8/16, d=11.2M on a real
+    chip (PERF.md).
     """
     g = as_stack(gradients)
     n = g.shape[0]

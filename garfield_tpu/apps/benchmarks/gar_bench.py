@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ... import aggregators
+from ...utils import profiling
 
 # Practical bound for brute's exhaustive enumeration, like the reference's
 # sweep bound (gar_bench.py:51 keeps n small for brute).
@@ -77,9 +78,7 @@ def bench_one(gar, n, f, d, reps, key):
         np.asarray(s[0, :1])  # host readback: the only reliable sync
         return time.perf_counter() - t0
 
-    t1 = timed(reps)
-    t2 = timed(2 * reps)
-    return max((t2 - t1) / reps, 1e-9)
+    return profiling.paired_reps(timed, reps)
 
 
 def main(argv=None):

@@ -55,9 +55,7 @@ def bench_gather(mesh, d, reps):
         np.asarray(x[0, :1])
         return time.perf_counter() - t0
 
-    t1 = timed(reps)
-    t2 = timed(2 * reps)
-    return max((t2 - t1) / reps, 1e-9)
+    return profiling.paired_reps(timed, reps)
 
 
 def main(argv=None):

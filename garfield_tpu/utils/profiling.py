@@ -20,10 +20,31 @@ import numpy as np
 
 __all__ = [
     "StepTimer",
+    "paired_reps",
     "trace",
     "collective_bytes",
     "convert_to_gbit",
 ]
+
+
+def paired_reps(timed_fn, reps, floor=1e-9):
+    """Per-iteration latency via the paired-reps difference estimator.
+
+    ``timed_fn(k)`` must run k *dependency-chained* iterations ended by a
+    host-readback sync, and return the elapsed wall seconds. The chain is run
+    at ``reps`` and ``2 * reps`` and the difference divided by ``reps`` —
+    any constant per-run cost (queue flush, readback round trip) cancels.
+
+    This is the only timing that holds up on tunneled/remote device
+    backends, where ``jax.block_until_ready`` can return before the device
+    finishes and a host readback (the one reliable sync) carries a large
+    constant queue-flush cost; naive per-call block-and-subtract timing
+    under-measures there by orders of magnitude (PERF.md "Timing
+    methodology").
+    """
+    t1 = timed_fn(reps)
+    t2 = timed_fn(2 * reps)
+    return max((t2 - t1) / reps, floor)
 
 
 class StepTimer:
