@@ -57,7 +57,8 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
     a("--log", action="store_true", help="Print loss every iteration.")
     # --- knobs hard-coded in the reference ---
     a("--attack", type=str, default=None,
-      help="Byzantine gradient attack: random, reverse, drop, lie, empire.")
+      help="Byzantine gradient attack: random, reverse, drop, lie, empire, "
+           "crash.")
     a("--attack_params", type=json.loads, default={},
       help="Attack parameters as JSON (e.g. lie z, empire eps).")
     a("--subset", type=int, default=None,
@@ -77,6 +78,15 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
     a("--dtype", type=str, default="float32",
       choices=["float32", "bfloat16"],
       help="Model compute dtype (bfloat16 routes matmuls to the MXU).")
+    a("--fault_crashes", type=json.loads, default=None,
+      help='Host crash schedule as JSON {"host": step, ...}: from the given '
+           "step on, that simulated host's worker slots feed zero gradients "
+           "(crash attack) and count against the Byzantine budget — the "
+           "host-level fault simulation of utils/multihost.FaultSchedule "
+           "(the reference's mar='crash', Garfield_CC/trainer.py:97,137).")
+    a("--fault_hosts", type=int, default=None,
+      help="Number of simulated hosts the worker slots fold onto for "
+           "--fault_crashes (default: one host per worker slot).")
     # --- new capabilities (absent in the reference) ---
     a("--checkpoint_dir", type=str, default=None,
       help="Directory for orbax checkpoints (reference has none).")
@@ -163,22 +173,83 @@ def load_data(args, num_slots):
     return xs, ys, test, xs.shape[1]
 
 
+def _crash_schedule(args, num_slots, declared_f):
+    """Validated FaultSchedule from --fault_crashes, or None.
+
+    Fails fast (before any data/model work) on: combination with --attack,
+    host layouts that leave slots unattached or hosts empty, out-of-range
+    host ids, and crash counts exceeding the declared Byzantine budget —
+    each of which would otherwise make the experiment silently wrong.
+    """
+    crashes = getattr(args, "fault_crashes", None)
+    if not crashes:
+        return None
+    if getattr(args, "attack", None):
+        raise SystemExit(
+            "--fault_crashes simulates crashed slots as zero-gradient "
+            "(crash-attack) rows and cannot be combined with --attack; "
+            "run the attack and the crash scenario separately."
+        )
+    num_hosts = getattr(args, "fault_hosts", None) or num_slots
+    if not (1 <= num_hosts <= num_slots) or num_slots % num_hosts:
+        raise SystemExit(
+            f"--fault_hosts {num_hosts} must evenly divide the "
+            f"{num_slots} worker slots (1 <= hosts <= slots)."
+        )
+    crashes = {int(k): int(v) for k, v in crashes.items()}
+    bad = [h for h in crashes if not 0 <= h < num_hosts]
+    if bad:
+        raise SystemExit(
+            f"--fault_crashes host ids {bad} out of range [0, {num_hosts})."
+        )
+    dead_slots = len(
+        [h for h, at in crashes.items() if at < args.num_iter]
+    ) * (num_slots // num_hosts)
+    if dead_slots > declared_f:
+        raise SystemExit(
+            f"--fault_crashes kills {dead_slots} worker slots by step "
+            f"{args.num_iter} but the declared Byzantine budget is "
+            f"{declared_f}; raise --fw (crashed slots count against it)."
+        )
+    from ..utils import multihost
+
+    return multihost.FaultSchedule(num_hosts, crashes=crashes)
+
+
 def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     """The reference training loop (Aggregathor/trainer.py:226-264), SPMD:
     batch selection by step index (batch i = train_set[i % len],
     worker.py:87), jit'd step, periodic accuracy, optional bench/profile
-    instrumentation, optional checkpointing."""
+    instrumentation, optional checkpointing. With --fault_crashes, the jit'd
+    step is rebuilt at each (rare) crash event so the dead hosts' slots turn
+    into zero-gradient Byzantine rows from that step on."""
+    import inspect
+
     t_start = time.time()
+    declared_f = make_trainer_kwargs.get("f", make_trainer_kwargs.get("fw", 0))
+    sched = _crash_schedule(args, num_slots, declared_f)
     xs_np, ys_np, test_batches, iters_per_epoch = load_data(args, num_slots)
     tools.info(
         f"[{tag}] One EPOCH consists of {iters_per_epoch} iterations"
     )
     module, loss_fn, optimizer = build_ingredients(args, iters_per_epoch)
     mesh = parse_mesh(args.mesh)
-    init_fn, step_fn, eval_fn = topology.make_trainer(
-        module, loss_fn, optimizer,
-        args.gar, mesh=mesh, **make_trainer_kwargs,
+    mask_key = (
+        "byz_mask"
+        if "byz_mask" in inspect.signature(topology.make_trainer).parameters
+        else "byz_worker_mask"  # byzsgd naming
     )
+
+    def build(step):
+        kwargs = dict(make_trainer_kwargs)
+        if sched is not None:
+            kwargs["attack"] = "crash"
+            kwargs[mask_key] = sched.byz_mask(step, num_slots)
+        return topology.make_trainer(
+            module, loss_fn, optimizer, args.gar, mesh=mesh, **kwargs
+        )
+
+    init_fn, step_fn, eval_fn = build(0)
 
     xs = jax.device_put(jnp.asarray(xs_np), step_fn.batch_sharding)
     ys = jax.device_put(jnp.asarray(ys_np), step_fn.batch_sharding)
@@ -203,8 +274,23 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     num_batches = xs.shape[1]
     metrics = {}
 
+    cur_mask = sched.byz_mask(start_iter, num_slots) if sched else None
+    if sched is not None and start_iter:
+        _, step_fn, _ = build(start_iter)
+
     t_train = time.time()
     for i in range(start_iter, args.num_iter):
+        if sched is not None:
+            mask = sched.byz_mask(i, num_slots)
+            if (mask != cur_mask).any():
+                cur_mask = mask
+                tools.info(
+                    f"[{tag}] crash event at step {i}: dead slots "
+                    f"{np.flatnonzero(mask).tolist()}; re-jitting step"
+                )
+                # Only the step depends on the mask — keep eval_fn's (and
+                # init_fn's) compiled programs.
+                _, step_fn, _ = build(i)
         b = i % num_batches
         profiling_this = args.profile_dir and i == start_iter + 5
         with profiling.trace(args.profile_dir if profiling_this else None):

@@ -92,12 +92,21 @@ def empire_attack(g, mask, *, eps=10.0, **_):
     return jnp.where(mask[:, None], fake[None, :], g)
 
 
+def crash_attack(g, mask, **_):
+    """Crash fault: the dead slots contribute all-zero gradients — what
+    Garfield_CC's ``mar='crash'`` mode feeds the aggregation
+    (Garfield_CC/trainer.py:97,137); used by the host-level fault
+    simulation (utils/multihost.FaultSchedule)."""
+    return jnp.where(mask[:, None], 0.0, g)
+
+
 gradient_attacks = {
     "random": random_attack,
     "reverse": reverse_attack,
     "drop": drop_attack,
     "lie": lie_attack,
     "empire": empire_attack,
+    "crash": crash_attack,
 }
 
 

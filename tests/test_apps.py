@@ -91,3 +91,47 @@ def test_checkpoint_resume(tmp_path):
         [a if a != "3" else "5" for a in ckpt_args] + ["--resume"]
     )
     assert int(state2.step) == 5
+
+
+def test_fault_crash_schedule():
+    """--fault_crashes: host 3 dies at step 2; the run re-jits the step with
+    that slot as a zero-gradient Byzantine row and still converges on the
+    remaining honest workers (SURVEY §5 failure simulation; the reference's
+    mar='crash', Garfield_CC/trainer.py:97,137)."""
+    state, summary = app_aggregathor.main(
+        FAST + ["--num_workers", "8", "--fw", "2", "--gar", "median",
+                "--num_iter", "5",
+                "--fault_crashes", json.dumps({"3": 2})]
+    )
+    assert int(state.step) == 5
+    assert summary["final_loss"] is not None
+    import numpy as np
+
+    assert np.isfinite(summary["final_loss"])
+
+
+def test_fault_crashes_rejects_attack_combo():
+    with pytest.raises(SystemExit):
+        app_aggregathor.main(
+            FAST + ["--num_workers", "8", "--fw", "2", "--gar", "median",
+                    "--attack", "lie",
+                    "--fault_crashes", json.dumps({"0": 1})]
+        )
+
+
+def test_fault_crashes_validates_budget_and_layout():
+    base = FAST + ["--num_workers", "8", "--gar", "median", "--num_iter", "5"]
+    with pytest.raises(SystemExit):  # 3 dead slots > fw=2
+        app_aggregathor.main(
+            base + ["--fw", "2",
+                    "--fault_crashes", json.dumps({"0": 0, "1": 0, "2": 0})]
+        )
+    with pytest.raises(SystemExit):  # hosts don't divide slots
+        app_aggregathor.main(
+            base + ["--fw", "2", "--fault_hosts", "3",
+                    "--fault_crashes", json.dumps({"0": 0})]
+        )
+    with pytest.raises(SystemExit):  # host id out of range
+        app_aggregathor.main(
+            base + ["--fw", "2", "--fault_crashes", json.dumps({"9": 0})]
+        )
