@@ -137,6 +137,11 @@ def build_ingredients(args, iters_per_epoch=None):
     """(module, loss_fn, optimizer) from the CLI flags — the selector layer
     (garfieldpp/tools.py:47-123) applied exactly as the trainers do."""
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.loss == "bce" and models_lib.num_classes_dict.get(args.dataset) != 1:
+        raise SystemExit(
+            f"--loss bce expects a binary dataset (pima), got "
+            f"{args.dataset!r}; use --loss nll or cross-entropy."
+        )
     module = models_lib.select_model(args.model, args.dataset, dtype=dtype)
     loss_fn = selectors.select_loss(args.loss)
     opt_args = _coerce_opt_args(dict(args.opt_args))
@@ -184,13 +189,15 @@ def _crash_schedule(args, num_slots, declared_f):
     crashes = getattr(args, "fault_crashes", None)
     if not crashes:
         return None
-    if getattr(args, "attack", None):
+    if getattr(args, "attack", None) or getattr(args, "model_attack", None):
         raise SystemExit(
             "--fault_crashes simulates crashed slots as zero-gradient "
-            "(crash-attack) rows and cannot be combined with --attack; "
-            "run the attack and the crash scenario separately."
+            "(crash-attack) rows and cannot be combined with "
+            "--attack/--model_attack; run the attack and the crash scenario "
+            "separately."
         )
-    num_hosts = getattr(args, "fault_hosts", None) or num_slots
+    num_hosts = getattr(args, "fault_hosts", None)
+    num_hosts = num_slots if num_hosts is None else num_hosts
     if not (1 <= num_hosts <= num_slots) or num_slots % num_hosts:
         raise SystemExit(
             f"--fault_hosts {num_hosts} must evenly divide the "
@@ -234,9 +241,9 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     )
     module, loss_fn, optimizer = build_ingredients(args, iters_per_epoch)
     mesh = parse_mesh(args.mesh)
+    trainer_params = inspect.signature(topology.make_trainer).parameters
     mask_key = (
-        "byz_mask"
-        if "byz_mask" in inspect.signature(topology.make_trainer).parameters
+        "byz_mask" if "byz_mask" in trainer_params
         else "byz_worker_mask"  # byzsgd naming
     )
 
@@ -245,6 +252,10 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
         if sched is not None:
             kwargs["attack"] = "crash"
             kwargs[mask_key] = sched.byz_mask(step, num_slots)
+            if "model_attack" in trainer_params:
+                # LEARN phase-5 model gossip: a crashed node cannot serve its
+                # model either — zero it with the model-space crash attack.
+                kwargs["model_attack"] = "crash"
         return topology.make_trainer(
             module, loss_fn, optimizer, args.gar, mesh=mesh, **kwargs
         )

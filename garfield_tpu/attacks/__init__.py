@@ -22,9 +22,11 @@ close to the reference semantics:
   - randomized attacks thread an explicit ``jax.random`` key instead of torch
     global RNG, keeping steps reproducible and replay-exact.
 
-Registries mirror the reference dicts:
-  ``gradient_attacks``: random, reverse, drop, lie, empire
-  ``model_attacks``:    random, reverse, drop
+Registries mirror the reference dicts, plus the crash fault:
+  ``gradient_attacks``: random, reverse, drop, lie, empire, crash
+  ``model_attacks``:    random, reverse, drop, crash
+(``crash`` zeroes the dead slot's contribution — Garfield_CC's
+``mar='crash'`` semantics — used by utils/multihost.FaultSchedule.)
 """
 
 import jax
@@ -157,10 +159,17 @@ def model_drop_attack(m, *, key, p=0.3, **_):
     return jnp.where(drop, 0.0, m)
 
 
+def model_crash_attack(m, **_):
+    """Crash fault: a dead node serves an all-zero model (the model-space
+    twin of ``crash_attack``; a crashed host cannot gossip its state)."""
+    return jnp.zeros_like(m)
+
+
 model_attacks = {
     "random": model_random_attack,
     "reverse": model_reverse_attack,
     "drop": model_drop_attack,
+    "crash": model_crash_attack,
 }
 
 

@@ -135,3 +135,18 @@ def test_fault_crashes_validates_budget_and_layout():
         app_aggregathor.main(
             base + ["--fw", "2", "--fault_crashes", json.dumps({"9": 0})]
         )
+
+
+def test_fault_crash_learn_model_gossip():
+    """In LEARN, a crashed node must not gossip its (honest) model either:
+    the fault wiring sets the model-space crash attack alongside the
+    gradient one."""
+    state, summary = app_learn.main(
+        FAST + ["--num_workers", "8", "--fw", "2", "--gar", "median",
+                "--num_iter", "4",
+                "--fault_crashes", json.dumps({"2": 1})]
+    )
+    assert int(state.step) == 4
+    import numpy as np
+
+    assert np.isfinite(summary["final_loss"])
