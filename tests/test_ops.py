@@ -88,3 +88,24 @@ def test_dispatch_falls_back_off_tpu():
         np.asarray(coordinate.coordinate_median(x)),
         np.asarray(coordinate.coordinate_median_reference(jnp.asarray(x))),
     )
+
+
+def test_median_bf16():
+    """bfloat16 stacks go through the same kernels (16-sublane tiling)."""
+    x = _rand(9, 257, seed=21).astype(jnp.bfloat16)
+    got = coordinate.coordinate_median(x, interpret=True, tile=128)
+    want = coordinate.coordinate_median_reference(jnp.asarray(x))
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(got, np.float32), np.asarray(want, np.float32)
+    )
+
+
+def test_averaged_median_mean_bf16():
+    x = _rand(7, 140, seed=22).astype(jnp.bfloat16)
+    got = coordinate.averaged_median_mean(x, 3, interpret=True, tile=128)
+    want = coordinate.averaged_median_mean_reference(jnp.asarray(x), 3)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=2e-2, atol=1e-2,
+    )
