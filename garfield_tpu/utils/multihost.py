@@ -168,3 +168,66 @@ class FaultSchedule:
             if h not in self.dead_hosts(step) and rng.random() < prob
         )
         return max(n - f, n - slow)
+
+
+def _cli(argv=None):
+    """Cluster-config writer CLI (counterpart of the reference's interactive
+    per-app ``config_generator.py`` :30-90, which asks for the host lists and
+    per-task role/GAR/attack on stdin and writes one JSON per node).
+
+      python -m garfield_tpu.utils.multihost out/ --workers h1 h2 --ps h0 \\
+          --gar krum --fw 1 --attack lie
+
+    Writes ``out/task_<role><i>.json`` for every task; with no host flags it
+    prompts interactively like the reference.
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(description="Garfield cluster config writer")
+    p.add_argument("out_dir", help="Directory for the per-task config files.")
+    p.add_argument("--workers", nargs="*", default=None,
+                   help="Worker host[:port] list.")
+    p.add_argument("--ps", nargs="*", default=[],
+                   help="Parameter-server host[:port] list.")
+    p.add_argument("--gar", default="average")
+    p.add_argument("--attack", default=None)
+    p.add_argument("--fw", type=int, default=0)
+    p.add_argument("--fps", type=int, default=0)
+    args = p.parse_args(argv)
+
+    workers, ps = args.workers, list(args.ps)
+    if workers is None:  # interactive, like config_generator.py
+        workers = input("Worker hosts (space-separated host[:port]): ").split()
+        if not ps:  # keep an explicitly passed --ps list
+            ps = input("PS hosts (space-separated, empty for none): ").split()
+    if not workers:
+        raise SystemExit("config needs at least one worker host (--workers).")
+    if not (0 <= args.fw) or args.fw * 2 >= len(workers):
+        raise SystemExit(
+            f"--fw {args.fw} incompatible with {len(workers)} workers "
+            f"(need 0 <= 2*fw < workers, the apps' contract)."
+        )
+    if not (0 <= args.fps) or (ps and args.fps * 2 >= len(ps)) or (args.fps and not ps):
+        raise SystemExit(
+            f"--fps {args.fps} incompatible with {len(ps)} ps hosts "
+            f"(need 0 <= 2*fps < ps)."
+        )
+    os.makedirs(args.out_dir, exist_ok=True)
+    garfield = {"gar": args.gar, "fw": args.fw, "fps": args.fps}
+    if args.attack:
+        garfield["attack"] = args.attack
+    written = []
+    for role, hosts in (("ps", ps), ("worker", workers)):
+        for i in range(len(hosts)):
+            path = os.path.join(args.out_dir, f"task_{role}{i}.json")
+            generate_config(
+                path, workers=workers, ps=ps,
+                task_type=role, task_index=i, **garfield,
+            )
+            written.append(path)
+    tools.info(f"[multihost] wrote {len(written)} config(s) to {args.out_dir}")
+    return written
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via tests calling _cli
+    _cli()

@@ -98,3 +98,33 @@ def test_transfer_bench_smoke():
 
     rows = transfer_bench.main(["--ds", "100", "--reps", "2"])
     assert rows and all(r["gbit_per_s"] > 0 for r in rows)
+
+
+def test_multihost_config_cli(tmp_path):
+    """Flag-driven config generator writes one valid per-task JSON per host
+    (reference config_generator.py parity)."""
+    from garfield_tpu.utils import multihost
+
+    files = multihost._cli([
+        str(tmp_path), "--workers", "h1:9901", "h2:9901", "h3:9901",
+        "--ps", "h0:9901", "--gar", "krum", "--fw", "1", "--attack", "lie",
+    ])
+    assert len(files) == 4
+    for i, f in enumerate(files):
+        cfg = multihost.ClusterConfig(f)
+        assert cfg.num_processes == 4
+        assert cfg.coordinator == "h0:9901"
+        assert cfg.garfield["gar"] == "krum"
+        assert cfg.process_id == i  # ps first, then workers, stable order
+
+
+def test_multihost_config_cli_validation(tmp_path):
+    from garfield_tpu.utils import multihost
+
+    with pytest.raises(SystemExit):  # no workers
+        multihost._cli([str(tmp_path), "--workers"])
+    with pytest.raises(SystemExit):  # fw budget too big
+        multihost._cli([str(tmp_path), "--workers", "h1", "h2", "--fw", "1"])
+    with pytest.raises(SystemExit):  # fps without ps hosts
+        multihost._cli([str(tmp_path), "--workers", "h1", "h2", "h3",
+                        "--fps", "1"])
