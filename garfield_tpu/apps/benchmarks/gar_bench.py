@@ -37,6 +37,7 @@ def max_f(rule, n):
         "condense": (n - 2) // 2,
         "aksel": (n - 1) // 2,
         "median": (n - 1) // 2,
+        "tmean": (n - 1) // 2,
         "average": (n - 1) // 2,
     }
     base = rule.split("native-")[-1]

@@ -19,6 +19,7 @@ from .coordinate import (
     MAX_SORT_N,
     averaged_median_mean,
     coordinate_median,
+    trimmed_mean,
     use_pallas,
 )
 
@@ -26,5 +27,6 @@ __all__ = [
     "MAX_SORT_N",
     "averaged_median_mean",
     "coordinate_median",
+    "trimmed_mean",
     "use_pallas",
 ]

@@ -95,6 +95,14 @@ def _median_kernel(n, x_ref, o_ref):
     o_ref[0, :] = rows[(n - 1) // 2]
 
 
+def _tmean_kernel(n, f, x_ref, o_ref):
+    rows = _oddeven_exchange([x_ref[i, :] for i in range(n)])
+    acc = rows[f]
+    for i in range(f + 1, n - f):
+        acc = acc + rows[i]
+    o_ref[0, :] = acc / (n - 2 * f)
+
+
 def _avgmed_kernel(s, beta, x_ref, o_ref):
     vals = [x_ref[i, :] for i in range(s)]
     med = _oddeven_exchange(list(vals))[(s - 1) // 2]
@@ -132,6 +140,13 @@ def coordinate_median_reference(g):
     return jnp.sort(g, axis=0)[(n - 1) // 2]
 
 
+def trimmed_mean_reference(g, f):
+    """jnp spec: drop the f smallest/largest per coordinate, average rest
+    (NaN sorts last, so up to f NaNs per coordinate land in the tail)."""
+    n = g.shape[0]
+    return jnp.mean(jnp.sort(g, axis=0)[f : n - f], axis=0)
+
+
 def averaged_median_mean_reference(g, beta):
     """jnp spec for Bulyan phase 2 (bulyan.py:77-84)."""
     med = coordinate_median_reference(g)
@@ -149,6 +164,21 @@ def coordinate_median(g, *, interpret=False, tile=_TILE):
     if n == 1:
         return g[0]
     kernel = functools.partial(_median_kernel, n)
+    return _column_call(kernel, g, tile, interpret)
+
+
+def trimmed_mean(g, f, *, interpret=False, tile=_TILE):
+    """Coordinate-wise trimmed mean: average of rows f..n-f-1 per sorted
+    column, fused into the sorting-network kernel (one HBM pass)."""
+    g = jnp.asarray(g)
+    n = g.shape[0]
+    if not (0 <= f and n - 2 * f >= 1):
+        raise ValueError(f"need n - 2f >= 1, got n={n}, f={f}")
+    if not interpret and not use_pallas(n):
+        return trimmed_mean_reference(g, f)
+    if n == 1:
+        return g[0]
+    kernel = functools.partial(_tmean_kernel, n, f)
     return _column_call(kernel, g, tile, interpret)
 
 

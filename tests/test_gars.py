@@ -276,5 +276,29 @@ def test_jit_compatible():
 
 
 def test_registry_contents():
-    for name in ("average", "median", "krum", "bulyan", "brute", "aksel", "condense"):
+    for name in ("average", "median", "tmean", "krum", "bulyan", "brute", "aksel",
+                 "condense"):
         assert name in gars, f"GAR {name} missing from registry"
+
+
+def test_tmean_golden():
+    """Trimmed mean: drop f largest/smallest per coordinate, average rest."""
+    g = np.array(
+        [[0.0, 100.0], [1.0, 1.0], [2.0, 2.0], [3.0, 3.0], [-50.0, 4.0]],
+        np.float32,
+    )
+    out = np.asarray(gars["tmean"](g, f=1))
+    # col0 sorted: -50,0,1,2,3 -> mean(0,1,2)=1; col1: 1,2,3,4,100 -> 3
+    np.testing.assert_allclose(out, [1.0, 3.0])
+    assert gars["tmean"].check(g, f=2) is None
+    assert gars["tmean"].check(g, f=3) is not None  # needs n >= 2f+1
+    assert gars["tmean"].upper_bound(9, 2, 10) == pytest.approx(
+        1 / np.sqrt(7)
+    )
+
+
+def test_tmean_nan_trimmed():
+    g = np.ones((7, 4), np.float32)
+    g[0] = np.nan  # sorts last per coordinate -> inside the trimmed tail
+    out = np.asarray(gars["tmean"](g, f=1))
+    np.testing.assert_allclose(out, np.ones(4))

@@ -109,3 +109,17 @@ def test_averaged_median_mean_bf16():
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         rtol=2e-2, atol=1e-2,
     )
+
+
+@pytest.mark.parametrize("n,f", [(3, 1), (7, 2), (9, 0), (11, 5)])
+def test_trimmed_mean_matches_reference(n, f):
+    x = _rand(n, 300, seed=n * 17 + f, nan_frac=0.05 if f else 0.0)
+    got = coordinate.trimmed_mean(x, f, interpret=True, tile=128)
+    want = coordinate.trimmed_mean_reference(jnp.asarray(x), f)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_trimmed_mean_bounds():
+    x = _rand(4, 8, seed=1)
+    with pytest.raises(ValueError):
+        coordinate.trimmed_mean(x, 2, interpret=True)  # n - 2f = 0
