@@ -27,6 +27,9 @@ from ...utils import profiling
 # sweep bound (gar_bench.py:51 keeps n small for brute).
 BRUTE_MAX_N = 25
 
+# bench_one sentinel: the rule's contract rejects this (n, f) combination.
+INCOMPATIBLE = object()
+
 
 def max_f(rule, n):
     """Largest f each rule's contract admits (aggregators/*.check)."""
@@ -49,7 +52,7 @@ def bench_one(gar, n, f, d, reps, key):
     kwargs = {"f": f} if f else {}
     try:
         if gar.check(np.zeros((n, 2), np.float32), **kwargs) is not None:
-            return None
+            return INCOMPATIBLE
     except TypeError:
         pass
     # Timing that survives tunneled/remote device backends, where
@@ -112,13 +115,18 @@ def main(argv=None):
                     print(f"{name} n={n} f={f} d={d}: SKIP ({exc})",
                           file=sys.stderr)
                     continue
-                if latency is None:
+                if latency is INCOMPATIBLE:
                     continue
                 row = {"gar": name, "n": n, "f": f, "d": d,
                        "latency_s": latency}
                 results.append(row)
-                print(f"{name:>16} n={n:<4} f={f:<3} d={d:<7} "
-                      f"{latency * 1e3:8.3f} ms", flush=True)
+                if latency is None:  # below noise floor (paired_reps)
+                    row["below_noise_floor"] = True
+                    print(f"{name:>16} n={n:<4} f={f:<3} d={d:<7} "
+                          f"below noise floor", flush=True)
+                else:
+                    print(f"{name:>16} n={n:<4} f={f:<3} d={d:<7} "
+                          f"{latency * 1e3:8.3f} ms", flush=True)
     if args.json:
         with open(args.json, "w") as fp:
             json.dump(results, fp, indent=1)

@@ -73,6 +73,11 @@ def main(argv=None):
         mesh = mesh_lib.make_mesh({"workers": k}, devices=jax.devices()[:k])
         for d in args.ds:
             latency = bench_gather(mesh, d, args.reps)
+            if latency is None:  # below the host's noise floor (paired_reps)
+                print(f"k={k} d={d:<9} below noise floor", flush=True)
+                results.append({"devices": k, "d": d, "latency_s": None,
+                                "below_noise_floor": True})
+                continue
             payload = k * d * 4
             row = {
                 "devices": k, "d": d, "latency_s": latency,

@@ -27,7 +27,7 @@ __all__ = [
 ]
 
 
-def paired_reps(timed_fn, reps, floor=1e-9):
+def paired_reps(timed_fn, reps, floor=1e-9, pairs=3):
     """Per-iteration latency via the paired-reps difference estimator.
 
     ``timed_fn(k)`` must run k *dependency-chained* iterations ended by a
@@ -41,10 +41,29 @@ def paired_reps(timed_fn, reps, floor=1e-9):
     constant queue-flush cost; naive per-call block-and-subtract timing
     under-measures there by orders of magnitude (PERF.md "Timing
     methodology").
+
+    Noise handling: on a shared chip a single (t1, t2) pair can come out
+    with ``t2 - t1 <= 0``; flooring that would report ``1/floor`` as a
+    plausible-looking throughput. Up to ``pairs`` independent pairs are
+    measured (stopping early once two agree to be positive), differences at
+    or below ``floor`` are discarded as noise-dominated, and the median of
+    the rest is returned. Returns **None** when every pair is
+    noise-dominated — the workload is below this host's measurement floor
+    and no number would be honest; callers must treat None as
+    "unmeasurable", not zero.
     """
-    t1 = timed_fn(reps)
-    t2 = timed_fn(2 * reps)
-    return max((t2 - t1) / reps, floor)
+    diffs = []
+    for _ in range(max(1, pairs)):
+        t1 = timed_fn(reps)
+        t2 = timed_fn(2 * reps)
+        d = (t2 - t1) / reps
+        if d > floor:
+            diffs.append(d)
+        if len(diffs) >= 2:
+            break
+    if not diffs:
+        return None
+    return float(np.median(diffs))
 
 
 class StepTimer:
