@@ -76,7 +76,10 @@ def aggregate(gradients, f, m=None, **kwargs):
     selected = weights @ jnp.where(used[:, None], g, 0)  # (rounds, d)
 
     # Coordinate-wise averaged median (bulyan.py:77-84); fused Pallas kernel
-    # on TPU (garfield_tpu/ops/coordinate.py), jnp sort+argsort+gather else.
+    # on TPU (garfield_tpu/ops/coordinate.py); off the Pallas path the
+    # gather-free threshold formulation (averaged_median_mean_xla), so
+    # n > MAX_SORT_N degrades gracefully instead of hitting the
+    # catastrophic sort+argsort+gather.
     from .. import ops
 
     beta = rounds - 2 * f

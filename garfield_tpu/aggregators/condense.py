@@ -5,9 +5,12 @@ Bernoulli(p) mask per coordinate; output = mask * median + (1-mask) * g[0].
 Requires n >= 2f+2 (:56).
 
 Randomness: jax is functionally pure, so the rule takes an explicit PRNG
-``key``. When omitted (host-side convenience, matching the reference's use of
-the torch global RNG), a module-level counter-derived key is used — calls
-remain deterministic per process but vary per call. Inside jit, pass ``key``.
+``key`` — the topologies all derive one from their replicated per-step rng
+and pass it in (the torch-global-RNG coupling of the reference has no
+counterpart here). When ``key`` is omitted (host-side convenience, e.g.
+calling ``gars["condense"](stack, f=1)`` at a REPL), a fixed key(0) is used:
+deterministic and independent of call order — pass distinct keys to vary
+the mask.
 """
 
 import math
@@ -18,16 +21,12 @@ import jax.numpy as jnp
 from . import register
 from ._common import as_stack, coordinate_median, num_gradients
 
-_fallback_count = 0
-
 
 def aggregate(gradients, f, p=0.9, key=None, **kwargs):
     """Bernoulli(p)-masked mix of coordinate median and gradient 0."""
     g = as_stack(gradients)
     if key is None:
-        global _fallback_count
-        key = jax.random.key(_fallback_count)
-        _fallback_count += 1
+        key = jax.random.key(0)
     mask = jax.random.bernoulli(key, p, shape=(g.shape[1],)).astype(g.dtype)
     return coordinate_median(g) * mask + g[0] * (1.0 - mask)
 

@@ -5,6 +5,10 @@ node is Worker + Server (:224-231); per step each node aggregates everyone's
 gradients, optionally runs ceil(log2 t) extra agreement rounds for non-iid
 data (:208-222, :251-252), then gossips and GAR-aggregates models (:255-257).
 ``--num_workers`` is the node count (the reference demo calls it n).
+``--subset`` enables the wait-n-f path: the reference's LEARN always waits
+for only the n - f fastest peers (trainer.py:249, :255); pass
+``--subset $((n - f))`` for exact protocol parity, or leave unset for full
+participation.
 
   python -m garfield_tpu.apps.learn --dataset pima --model pimanet \\
       --loss bce --num_workers 8 --fw 1 --gar median \\
@@ -47,6 +51,7 @@ def main(argv=None):
             model_attack=args.model_attack,
             non_iid=args.non_iid,
             model_gossip=not args.no_model_gossip,
+            subset=args.subset,
         ),
         num_slots=args.num_workers,
         tag="learn",

@@ -60,10 +60,11 @@ def _check_gar(gar, n_effective, f, d=2):
 
 
 def _attack_then_aggregate(
-    flat_stack, byz_mask, atk_key, sub_key, *, attack, attack_params, gar,
-    f, subset,
+    flat_stack, byz_mask, atk_key, sub_key, gar_key, *, attack,
+    attack_params, gar, f, subset,
 ):
-    """Poison rows, optionally subsample (wait n-f), aggregate. Pure."""
+    """Poison rows, optionally subsample (wait n-f), aggregate. Pure.
+    ``gar_key`` seeds randomized rules (condense's Bernoulli mask)."""
     n = flat_stack.shape[0]
     stack = apply_gradient_attack(
         attack, flat_stack, byz_mask, key=atk_key, **attack_params
@@ -71,7 +72,7 @@ def _attack_then_aggregate(
     if subset is not None and subset < n:
         sel = core.subset_indices(sub_key, n, subset)
         stack = stack[sel]
-    return gar.unchecked(stack, f=f)
+    return gar.unchecked(stack, f=f, key=gar_key)
 
 
 def make_trainer(
@@ -141,7 +142,7 @@ def make_trainer(
         """Body run per shard under shard_map."""
         params, ms = state.params, state.model_state
         base = jax.random.fold_in(state.rng, state.step)
-        atk_key, sub_key, drop_base = jax.random.split(base, 3)
+        atk_key, sub_key, gar_key, drop_base = jax.random.split(base, 4)
         shard_idx = jax.lax.axis_index(axis)
         slot_ids = shard_idx * per_shard + jnp.arange(per_shard)
         drop_keys = jax.vmap(lambda i: jax.random.fold_in(drop_base, i))(slot_ids)
@@ -174,15 +175,16 @@ def make_trainer(
                 n = leaf.shape[0]
                 flat = leaf.reshape(n, -1)
                 akey = jax.random.fold_in(atk_key, i)
+                gkey = jax.random.fold_in(gar_key, i)
                 aggr = _attack_then_aggregate(
-                    flat, byz_mask, akey, sub_key, **agg_kwargs
+                    flat, byz_mask, akey, sub_key, gkey, **agg_kwargs
                 )
                 out_leaves.append(aggr.reshape(leaf.shape[1:]))
             aggr_tree = jax.tree.unflatten(treedef, out_leaves)
         else:
             flat_stack = core.flatten_rows(grads)
             aggr = _attack_then_aggregate(
-                flat_stack, byz_mask, atk_key, sub_key, **agg_kwargs
+                flat_stack, byz_mask, atk_key, sub_key, gar_key, **agg_kwargs
             )
             aggr_tree = core.unflatten_like(params, aggr)
 

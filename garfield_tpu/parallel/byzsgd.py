@@ -132,7 +132,8 @@ def make_trainer(
         """One server's gradient phase: attack is already applied; sample this
         PS's own arrival subset, aggregate, update (server.py:112-159 +
         update_model :277-287)."""
-        atk_unused, sub_key = keys
+        sub_key, gar_key = keys
+        gkey = jax.random.fold_in(gar_key, ps_id)
         stack = grads_stack
         n = stack.shape[0]
         if subset is not None and subset < n:
@@ -142,11 +143,14 @@ def make_trainer(
             stack = stack[sel]
         if granularity == "layer":
             aggr = core.segmented_aggregate(
-                lambda s: gar.unchecked(s, f=fw), stack,
+                lambda s, i: gar.unchecked(
+                    s, f=fw, key=jax.random.fold_in(gkey, i)
+                ),
+                stack,
                 core.leaf_segments(params),
             )
         else:
-            aggr = gar.unchecked(stack, f=fw)
+            aggr = gar.unchecked(stack, f=fw, key=gkey)
         updates, new_opt = optimizer.update(
             core.unflatten_like(params, aggr), opt_state, params
         )
@@ -154,7 +158,8 @@ def make_trainer(
 
     def _local_step(state, x_local, y_local):
         base = jax.random.fold_in(state.rng, state.step)
-        atk_key, sub_key, psatk_key, drop_base = jax.random.split(base, 4)
+        (atk_key, sub_key, psatk_key, drop_base,
+         gar_key, mgar_key) = jax.random.split(base, 6)
         ps_shard = jax.lax.axis_index(ps_axis)
         w_shard = jax.lax.axis_index(axis)
         ps_ids = ps_shard * per_ps + jnp.arange(per_ps)
@@ -200,7 +205,7 @@ def make_trainer(
 
         new_params, new_opt = jax.vmap(
             _ps_slot_step, in_axes=(0, 0, 0, 0, None)
-        )(ps_ids, state.params, state.opt_state, stacks, (atk_key, sub_key))
+        )(ps_ids, state.params, state.opt_state, stacks, (sub_key, gar_key))
 
         # --- model gather phase (ByzSGD/trainer.py:240-244) ----------------
         flat_models = core.flatten_rows(new_params)  # (per_ps, d)
@@ -215,11 +220,14 @@ def make_trainer(
         params0 = jax.tree.map(lambda l: l[0], new_params)
         if granularity == "layer":
             aggr_model = core.segmented_aggregate(
-                lambda s: model_gar.unchecked(s, f=fps), models,
+                lambda s, i: model_gar.unchecked(
+                    s, f=fps, key=jax.random.fold_in(mgar_key, i)
+                ),
+                models,
                 core.leaf_segments(params0),
             )
         else:
-            aggr_model = model_gar.unchecked(models, f=fps)
+            aggr_model = model_gar.unchecked(models, f=fps, key=mgar_key)
         written = core.unflatten_like(params0, aggr_model)
         new_params = jax.tree.map(
             lambda l: jnp.broadcast_to(l[None], (per_ps,) + l.shape), written

@@ -133,10 +133,13 @@ def leaf_segments(tree):
 
 
 def segmented_aggregate(agg_fn, stack, segments):
-    """Apply ``agg_fn`` independently to each column segment of an (n, d)
-    stack and concatenate — per-layer aggregation over a flat stack."""
+    """Apply ``agg_fn(segment, i)`` independently to each column segment of
+    an (n, d) stack and concatenate — per-layer aggregation over a flat
+    stack. The segment index lets randomized rules fold a distinct key per
+    layer."""
     return jnp.concatenate(
-        [agg_fn(stack[:, s:e]) for s, e in segments], axis=0
+        [agg_fn(stack[:, s:e], i) for i, (s, e) in enumerate(segments)],
+        axis=0,
     )
 
 

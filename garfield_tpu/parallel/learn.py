@@ -20,6 +20,17 @@ gradient attacks (byzWorker.py) in phases 1-3 and model attacks
 (byzServer.py) in phase 5 — value transforms on their rows of the gathered
 stacks.
 
+Wait-n-f semantics: the reference's LEARN never waits for everyone — each
+node takes the *fastest* ``n - f`` peer responses at every exchange
+(``ps.get_gradients(i, n-f)`` trainer.py:249, ``get_models(n-f)`` :255, and
+``avg_agree``'s ``num_wait_ps`` :208-222). Arrival order is effectively
+random, so the bulk-synchronous stand-in is a per-node seeded subset
+(``core.subset_indices``, same pattern as byzsgd's per-PS subsets): each
+node aggregates its OWN q-subset of the gathered stack. That is exactly why
+honest nodes hold *different* aggregates — the disagreement the ceil(log2 t)
+agreement rounds exist to reconcile (and without which they would be vacuous
+re-aggregations of one vector).
+
 The ceil(log2 t) round count is data-dependent on the step counter, so the
 gossip loop is a ``lax.fori_loop`` over a static ``max_rounds`` with rounds
 beyond the target masked to no-ops (XLA needs static trip structure).
@@ -59,12 +70,23 @@ def make_trainer(
     non_iid=False,
     max_rounds=12,
     model_gossip=True,
+    subset=None,
+    track_spread=False,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the LEARN topology.
 
     ``non_iid=True`` enables the ceil(log2 t) agreement rounds
     (LEARN/trainer.py:251-252 runs them only for non-iid data); ``max_rounds``
     caps them (2^12 = 4096 steps of exact parity by default).
+    ``subset=q`` enables wait-n-f: every node aggregates its own seeded
+    q-subset of the gathered gradients / agreement aggregates / gossiped
+    models, the stand-in for taking the q = n - f *fastest* peer responses
+    (LEARN/trainer.py:249, :255, avg_agree :208-222). With it, honest nodes
+    hold genuinely different aggregates between agreement rounds.
+    ``track_spread=True`` adds ``aggr_spread_pre`` / ``aggr_spread_post``
+    metrics — the max pairwise L-inf distance between honest nodes'
+    aggregates before and after the agreement rounds (costs one extra
+    (n, d) all_gather; leave off in production).
     ``step_fn(state, x, y)``: leading ``num_nodes`` axis on x/y and on every
     params/opt_state leaf, all sharded over ``axis``.
     """
@@ -74,7 +96,11 @@ def make_trainer(
     if mesh is None:
         mesh = mesh_lib.make_mesh({axis: -1})
     per_n = mesh_lib.fold(num_nodes, mesh.shape[axis], "nodes")
-    _check_gar(gar, num_nodes, f)
+    if subset is not None and not (1 <= subset <= num_nodes):
+        raise ValueError(f"subset must be in [1, {num_nodes}], got {subset}")
+    # The GAR sees `subset` rows when waiting (reference passes the n-f
+    # received gradients straight to the rule, LEARN/trainer.py:241).
+    _check_gar(gar, subset if subset else num_nodes, f)
     if byz_mask is None:
         byz_mask = core.default_byz_mask(
             num_nodes, f if (attack or model_attack) else 0
@@ -99,11 +125,46 @@ def make_trainer(
             rng=jax.device_put(key if seed_rng is None else seed_rng, repl),
         )
 
+    waiting = subset is not None and subset < num_nodes
+
     def _local_step(state, x_local, y_local):
         base = jax.random.fold_in(state.rng, state.step)
-        atk_key, gossip_key, matk_key, drop_base = jax.random.split(base, 4)
+        (atk_key, gossip_key, matk_key, drop_base,
+         sub_key, msub_key) = jax.random.split(base, 6)
         shard = jax.lax.axis_index(axis)
         node_ids = shard * per_n + jnp.arange(per_n)
+
+        def node_aggregate(stack, key, nid):
+            """One node's view of an exchange: its own seeded arrival subset
+            (the q fastest peers), then the GAR. Keyed by the global node id
+            so every shard agrees on what node ``nid`` sampled."""
+            sel_key, gkey = jax.random.split(jax.random.fold_in(key, nid))
+            if waiting:
+                sel = core.subset_indices(sel_key, stack.shape[0], subset)
+                stack = stack[sel]
+            return gar.unchecked(stack, f=f, key=gkey)
+
+        def local_aggregates(stack, key):
+            """All of this shard's node slots aggregate the same gathered
+            stack through their own subsets -> (per_n, d). vmapped over the
+            node ids (one subset+GAR graph regardless of per_n, the same
+            shape as byzsgd's vmapped per-PS slot step)."""
+            if waiting:
+                return jax.vmap(
+                    lambda nid: node_aggregate(stack, key, nid)
+                )(node_ids)
+            # Full participation: one aggregate, identical for every node.
+            one = gar.unchecked(stack, f=f, key=key)
+            return jnp.broadcast_to(one[None], (per_n,) + one.shape)
+
+        def honest_spread(aggr_local):
+            """Max pairwise L-inf distance between honest nodes' aggregates:
+            the disagreement the agreement rounds must shrink."""
+            rows = jax.lax.all_gather(aggr_local, axis, tiled=True)  # (n, d)
+            byz = byz_mask[:, None]
+            hi = jnp.max(jnp.where(byz, -jnp.inf, rows), axis=0)
+            lo = jnp.min(jnp.where(byz, jnp.inf, rows), axis=0)
+            return jnp.max(hi - lo)
 
         # Phase 1: per-node gradient on its own model + batch (unrolled over
         # the static local slots; vmapping params over nodes trips conv
@@ -124,46 +185,59 @@ def make_trainer(
             jax.tree.map(lambda *ls: jnp.stack(ls), *ms_list), axis
         )
 
-        # Phase 2: gather + attack + aggregate (= get_gradients of every peer).
+        # Phase 2: gather + attack + aggregate (= get_gradients(i, n-f) of
+        # the fastest peers, LEARN/trainer.py:249; per-node subsets).
         stack0 = jax.lax.all_gather(flat_local, axis, tiled=True)  # (n, d)
         stack0 = apply_gradient_attack(
             attack, stack0, byz_mask, key=atk_key, **attack_params
         )
-        aggr = gar.unchecked(stack0, f=f)  # identical on all honest nodes
+        aggr_local = local_aggregates(stack0, sub_key)  # (per_n, d)
+
+        metrics_extra = {}
+        if track_spread:
+            metrics_extra["aggr_spread_pre"] = honest_spread(aggr_local)
 
         # Phase 3: avg_agree rounds (ceil(log2 t), LEARN/trainer.py:208-222).
+        # Each round every node PUBLISHES its own current aggregate (they
+        # differ under wait-n-f), Byzantine rows are poisoned, and each node
+        # re-aggregates its own num_wait_ps = q subset of the gathered stack
+        # (get_aggr_grads polling, server.py:202-233).
         if non_iid:
             t = jnp.maximum(state.step, 1).astype(jnp.float32)
             rounds = jnp.ceil(jnp.log2(jnp.maximum(t, 2.0))).astype(jnp.int32)
             rounds = jnp.minimum(rounds, max_rounds)
 
-            def round_body(r, aggr):
-                # Every round: each node publishes its current aggregate; the
-                # Byzantine rows are poisoned; re-aggregate.
-                served = jnp.broadcast_to(aggr[None], stack0.shape)
-                rkey = jax.random.fold_in(gossip_key, r)
+            def round_body(r, aggr_local):
+                served = jax.lax.all_gather(
+                    aggr_local, axis, tiled=True
+                )  # (n, d): every node's own aggregate, not n copies of one
+                akey, skey = jax.random.split(jax.random.fold_in(gossip_key, r))
                 served = apply_gradient_attack(
-                    attack, served, byz_mask, key=rkey, **attack_params
+                    attack, served, byz_mask, key=akey, **attack_params
                 )
-                new = gar.unchecked(served, f=f)
-                return jnp.where(r < rounds, new, aggr)
+                new = local_aggregates(served, skey)
+                return jnp.where(r < rounds, new, aggr_local)
 
-            aggr = jax.lax.fori_loop(0, max_rounds, round_body, aggr)
+            aggr_local = jax.lax.fori_loop(0, max_rounds, round_body, aggr_local)
 
-        # Phase 4: per-node optimizer step.
+        if track_spread:
+            metrics_extra["aggr_spread_post"] = honest_spread(aggr_local)
+
+        # Phase 4: per-node optimizer step on that node's own aggregate.
         new_params_list, new_opt_list = [], []
         for k in range(per_n):
             p_k = jax.tree.map(lambda l: l[k], state.params)
             o_k = jax.tree.map(lambda l: l[k], state.opt_state)
             updates, o_k = optimizer.update(
-                core.unflatten_like(p_k, aggr), o_k, p_k
+                core.unflatten_like(p_k, aggr_local[k]), o_k, p_k
             )
             new_params_list.append(optax.apply_updates(p_k, updates))
             new_opt_list.append(o_k)
         new_params = jax.tree.map(lambda *ls: jnp.stack(ls), *new_params_list)
         new_opt = jax.tree.map(lambda *ls: jnp.stack(ls), *new_opt_list)
 
-        # Phase 5: model gossip (LEARN/trainer.py:255-257).
+        # Phase 5: model gossip (LEARN/trainer.py:255-257, get_models(n-f) —
+        # each node GAR-aggregates its own subset of the gossiped models).
         if model_gossip:
             flat_models = core.flatten_rows(new_params)  # (per_n, d)
             models = jax.lax.all_gather(flat_models, axis, tiled=True)
@@ -174,13 +248,14 @@ def make_trainer(
                 )
             )(jnp.arange(num_nodes), models)
             models = jnp.where(byz_mask[:, None], poisoned, models)
-            aggr_model = gar.unchecked(models, f=f)
-            written = core.unflatten_like(
-                jax.tree.map(lambda l: l[0], new_params), aggr_model
-            )
+            aggr_models = local_aggregates(models, msub_key)  # (per_n, d)
+            template = jax.tree.map(lambda l: l[0], new_params)
             new_params = jax.tree.map(
-                lambda l: jnp.broadcast_to(l[None], (per_n,) + l.shape),
-                written,
+                lambda *ls: jnp.stack(ls),
+                *[
+                    core.unflatten_like(template, aggr_models[k])
+                    for k in range(per_n)
+                ],
             )
 
         honest = (~byz_mask).astype(losses.dtype)[node_ids]
@@ -195,7 +270,7 @@ def make_trainer(
                 model_state=new_ms,
                 opt_state=new_opt,
             ),
-            {"loss": mean_loss},
+            {"loss": mean_loss, **metrics_extra},
         )
 
     state_specs = core.TrainState(

@@ -123,3 +123,65 @@ def test_trimmed_mean_bounds():
     x = _rand(4, 8, seed=1)
     with pytest.raises(ValueError):
         coordinate.trimmed_mean(x, 2, interpret=True)  # n - 2f = 0
+
+
+@pytest.mark.parametrize("s,beta", [(8, 4), (33, 13), (64, 31), (128, 17)])
+def test_averaged_median_mean_xla_matches_reference(s, beta):
+    """The gather-free production fallback == the argsort+gather spec,
+    including at n > MAX_SORT_N where it is the only non-Pallas path."""
+    x = _rand(s, 300, seed=s * 7 + beta, nan_frac=0.05)
+    got = coordinate.averaged_median_mean_xla(jnp.asarray(x), beta)
+    want = coordinate.averaged_median_mean_reference(jnp.asarray(x), beta)
+    # atol: the masked sum and the gathered mean accumulate in different
+    # orders; near-zero coordinates differ by O(1e-8) in f32.
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_averaged_median_mean_xla_stable_ties():
+    x = np.array([[0.0], [1.0], [2.0], [5.0]], np.float32)  # median = 1.0
+    got = coordinate.averaged_median_mean_xla(jnp.asarray(x), 2)
+    np.testing.assert_array_equal(np.asarray(got), [0.5])  # rows 1 then 0
+    # Duplicated deviations across MANY rows: quota admits exactly the
+    # lowest-index ties.
+    x2 = np.array([[1.0], [1.0], [1.0], [1.0], [9.0]], np.float32)
+    got2 = coordinate.averaged_median_mean_xla(jnp.asarray(x2), 3)
+    want2 = coordinate.averaged_median_mean_reference(jnp.asarray(x2), 3)
+    np.testing.assert_array_equal(np.asarray(got2), np.asarray(want2))
+
+
+def test_averaged_median_mean_xla_nan_flood():
+    """> s - beta NaN rows per coordinate: spec result is NaN; the
+    threshold formulation must restore it, not silently emit 0."""
+    x = np.full((5, 3), np.nan, np.float32)
+    x[0] = 1.0  # one finite row, beta=3 must pull 2 NaN rows
+    got = coordinate.averaged_median_mean_xla(jnp.asarray(x), 3)
+    want = coordinate.averaged_median_mean_reference(jnp.asarray(x), 3)
+    assert np.isnan(np.asarray(want)).all()
+    assert np.isnan(np.asarray(got)).all()
+
+
+def test_large_n_fallback_warns_only_on_tpu_backend(monkeypatch):
+    """n > MAX_SORT_N: silent on CPU (Pallas was never an option), loud on
+    a TPU backend (the 75x fused path is being given up)."""
+    x = _rand(coordinate.MAX_SORT_N + 1, 16, seed=2)
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # CPU backend: must NOT warn
+        coordinate.coordinate_median(x)
+    monkeypatch.setattr(
+        coordinate.jax, "default_backend", lambda: "tpu"
+    )
+    coordinate._warned_large_n.discard("coordinate_median")
+    with pytest.warns(UserWarning, match="MAX_SORT_N"):
+        assert coordinate.use_pallas(
+            coordinate.MAX_SORT_N + 1, op="coordinate_median"
+        ) is False
+    # ... and only once per op per process.
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        coordinate.use_pallas(
+            coordinate.MAX_SORT_N + 1, op="coordinate_median"
+        )

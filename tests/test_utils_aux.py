@@ -90,14 +90,26 @@ def test_gar_bench_smoke():
         ["--gars", "median", "krum", "--ns", "8", "--ds", "10", "--reps", "2"]
     )
     assert {r["gar"] for r in rows} == {"median", "krum"}
-    assert all(r["latency_s"] > 0 for r in rows)
+    # latency is a positive float, or None with the below_noise_floor flag
+    # (tiny d on a fast backend legitimately sits under the paired-reps
+    # noise floor).
+    for r in rows:
+        if r["latency_s"] is None:
+            assert r.get("below_noise_floor") is True
+        else:
+            assert r["latency_s"] > 0
 
 
 def test_transfer_bench_smoke():
     from garfield_tpu.apps.benchmarks import transfer_bench
 
     rows = transfer_bench.main(["--ds", "100", "--reps", "2"])
-    assert rows and all(r["gbit_per_s"] > 0 for r in rows)
+    assert rows
+    for r in rows:  # below-noise rows carry no gbit_per_s
+        if r["latency_s"] is None:
+            assert r.get("below_noise_floor") is True
+        else:
+            assert r["gbit_per_s"] > 0
 
 
 def test_multihost_config_cli(tmp_path):
