@@ -16,6 +16,7 @@ All functions are pure and shape-polymorphic only in the static sense: n, d,
 f must be Python ints at trace time (XLA static shapes).
 """
 
+import jax
 import jax.numpy as jnp
 
 
@@ -41,23 +42,65 @@ def num_gradients(gradients):
     return int(gradients.shape[0])
 
 
+def distances_from_gram(gram, *, exclude_self=True):
+    """(n, n) Euclidean distances from a Gram matrix <g_i, g_j>.
+
+    ||x-y||^2 = ||x||^2 + ||y||^2 - 2<x,y>; the squared norms are the Gram
+    diagonal. Non-finite distances (a Byzantine gradient containing NaN/Inf
+    poisons its whole row) become +inf, mirroring the reference's isfinite
+    guard (krum.py:46-48). The diagonal is +inf when exclude_self (so
+    "k smallest" never counts the self-distance), else 0.
+    """
+    sq = jnp.diagonal(gram)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * gram
+    dist = jnp.sqrt(jnp.maximum(d2, 0.0))
+    dist = jnp.where(jnp.isfinite(dist), dist, jnp.inf)
+    n = gram.shape[0]
+    diag = jnp.inf if exclude_self else 0.0
+    return jnp.where(jnp.eye(n, dtype=bool), diag, dist)
+
+
 def pairwise_distances(g, *, exclude_self=True):
     """(n, n) Euclidean distance matrix via the Gram trick.
 
-    Uses ||x-y||^2 = ||x||^2 + ||y||^2 - 2<x,y> so the inner product rides
-    the MXU instead of materializing (n, n, d) differences. Non-finite
-    distances (a Byzantine gradient containing NaN/Inf poisons its whole row)
-    become +inf, mirroring the reference's isfinite guard (krum.py:46-48).
-    The diagonal is +inf when exclude_self (so "k smallest" never counts the
-    self-distance), else 0.
+    The inner product rides the MXU instead of materializing (n, n, d)
+    differences (see ``distances_from_gram``).
     """
-    sq = jnp.sum(g * g, axis=-1)
-    d2 = sq[:, None] + sq[None, :] - 2.0 * (g @ g.T)
-    dist = jnp.sqrt(jnp.maximum(d2, 0.0))
-    dist = jnp.where(jnp.isfinite(dist), dist, jnp.inf)
-    n = g.shape[0]
-    diag = jnp.inf if exclude_self else 0.0
-    return jnp.where(jnp.eye(n, dtype=bool), diag, dist)
+    return distances_from_gram(g @ g.T, exclude_self=exclude_self)
+
+
+def tree_gram(grads_tree):
+    """(n, n) Gram matrix of a stacked gradient tree, summed over leaves.
+
+    <g_i, g_j> over the flat concatenation equals the sum of per-leaf inner
+    products, so the Gram of the virtual (n, d) stack is computed without
+    ever materializing it — each leaf contributes one (n, size) MXU matmul.
+    Accumulated in float32 regardless of leaf dtype.
+    """
+    leaves = jax.tree.leaves(grads_tree)
+    n = leaves[0].shape[0]
+    total = jnp.zeros((n, n), jnp.float32)
+    for leaf in leaves:
+        x = leaf.reshape(n, -1).astype(jnp.float32)
+        total = total + x @ x.T
+    return total
+
+
+def tree_weighted_sum(grads_tree, w):
+    """Per-leaf weighted sum of rows: the tree analog of ``w @ stack``.
+
+    Zero-weight rows are masked out before the contraction so a NaN/Inf in
+    an unselected (Byzantine) row cannot poison the result (0 * inf = nan)
+    — same guard as the flat selection-average (krum.py docstring).
+    """
+    keep = (w != 0)
+
+    def one(leaf):
+        wl = w.astype(leaf.dtype)
+        mask = keep.reshape((-1,) + (1,) * (leaf.ndim - 1))
+        return jnp.tensordot(wl, jnp.where(mask, leaf, 0), axes=(0, 0))
+
+    return jax.tree.map(one, grads_tree)
 
 
 def coordinate_median(g):

@@ -4,6 +4,7 @@ Counterpart of pytorch_impl/libs/aggregators/average.py (:21-29 aggregate,
 influence = accepted fraction).
 """
 
+import jax
 import jax.numpy as jnp
 
 from . import register
@@ -13,6 +14,11 @@ from ._common import as_stack, num_gradients
 def aggregate(gradients, **kwargs):
     """Arithmetic mean of the gradients."""
     return jnp.mean(as_stack(gradients), axis=0)
+
+
+def tree_aggregate(grads_tree, **kwargs):
+    """Tree-mode mean over the leading slot axis (no flat stack)."""
+    return jax.tree.map(lambda l: jnp.mean(l, axis=0), grads_tree)
 
 
 def check(gradients, **kwargs):
@@ -26,4 +32,5 @@ def influence(honests, attacks, **kwargs):
     return len(attacks) / (len(honests) + len(attacks))
 
 
-register("average", aggregate, check, influence=influence)
+register("average", aggregate, check, influence=influence,
+         tree_aggregate=tree_aggregate)

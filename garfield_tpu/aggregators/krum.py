@@ -13,11 +13,36 @@ inside the surrounding jit'd train step.
 
 import math
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import register
-from ._common import as_stack, num_gradients, pairwise_distances
+from ._common import (
+    as_stack,
+    distances_from_gram,
+    num_gradients,
+    pairwise_distances,
+    tree_gram,
+    tree_weighted_sum,
+)
+
+
+def _scores_from_dist(dist, n, f):
+    """Krum score of row i = sum of its n-f-1 smallest distances to the
+    other rows (krum.py:55-63). The single source of the score formula —
+    the flat path, the tree path, and selection_indices all go through it,
+    so the trajectory-equality the tests assert cannot silently break.
+    """
+    sorted_d = jnp.sort(dist, axis=1)
+    return jnp.sum(sorted_d[:, : n - f - 1], axis=1)
+
+
+def _selection_weights_from_dist(dist, n, f, m):
+    """One-hot/m weight vector over the m best-scored rows (stable ties) —
+    the masked matvec form of ``mean(g[sel])`` (see ``aggregate``)."""
+    sel = jnp.argsort(_scores_from_dist(dist, n, f))[:m]
+    return jnp.zeros((n,), jnp.float32).at[sel].set(1.0 / m)
 
 
 def selection_indices(gradients, f, m=None):
@@ -27,10 +52,7 @@ def selection_indices(gradients, f, m=None):
     if m is None:
         m = n - f - 2
     dist = pairwise_distances(g)  # (n, n), diag/non-finite -> +inf
-    # Sum of the n-f-1 smallest distances to the other nodes (krum.py:55-63).
-    sorted_d = jnp.sort(dist, axis=1)
-    scores = jnp.sum(sorted_d[:, : n - f - 1], axis=1)
-    return jnp.argsort(scores)[:m]
+    return jnp.argsort(_scores_from_dist(dist, n, f))[:m]
 
 
 def aggregate(gradients, f, m=None, **kwargs):
@@ -46,13 +68,31 @@ def aggregate(gradients, f, m=None, **kwargs):
     n = g.shape[0]
     if m is None:
         m = n - f - 2
-    sel = selection_indices(g, f, m)
-    w = jnp.zeros((n,), g.dtype).at[sel].set(1.0 / m)
+    w = _selection_weights_from_dist(
+        pairwise_distances(g), n, f, m
+    ).astype(g.dtype)
     # Zero-weight rows must not poison the matvec with NaN/Inf coordinates
     # (0 * inf = nan); selected rows pass through untouched, preserving the
     # reference's mean(g[sel]) semantics exactly.
     gz = jnp.where((w != 0)[:, None], g, 0)
     return w @ gz
+
+
+def tree_aggregate(grads_tree, f, m=None, **kwargs):
+    """Tree-mode Multi-Krum: no (n, d) flat stack.
+
+    The pairwise distances need only the Gram matrix, which is the sum of
+    per-leaf Grams (``_common.tree_gram``); the selection average is a
+    per-leaf weighted row sum. Saves the flatten + unflatten round trip —
+    ~5 ms/step at ResNet-18 scale on one chip (PERF.md).
+    """
+    leaves = jax.tree.leaves(grads_tree)
+    n = leaves[0].shape[0]
+    if m is None:
+        m = n - f - 2
+    dist = distances_from_gram(tree_gram(grads_tree))
+    w = _selection_weights_from_dist(dist, n, f, m)
+    return tree_weighted_sum(grads_tree, w)
 
 
 def check(gradients, f, m=None, **kwargs):
@@ -89,4 +129,5 @@ def influence(honests, attacks, f, m=None, **kwargs):
     return float(np.sum(sel >= len(honests))) / m
 
 
-register("krum", aggregate, check, upper_bound=upper_bound, influence=influence)
+register("krum", aggregate, check, upper_bound=upper_bound,
+         influence=influence, tree_aggregate=tree_aggregate)

@@ -36,6 +36,7 @@ __all__ = [
     "gradient_attacks",
     "model_attacks",
     "apply_gradient_attack",
+    "apply_gradient_attack_tree",
     "apply_model_attack",
 ]
 
@@ -111,6 +112,30 @@ gradient_attacks = {
     "crash": crash_attack,
 }
 
+# Attacks that draw randomness (shared by both dispatchers below).
+_NEEDS_KEY = {random_attack, drop_attack}
+# Attacks that are coordinate-wise given per-coordinate masked row
+# statistics — the invariant that makes per-LEAF application
+# (apply_gradient_attack_tree) equivalent to flat application. A new
+# attack must be added here explicitly to become tree-capable; otherwise
+# the tree dispatcher rejects it instead of silently mis-applying it.
+_COORDINATE_WISE = {
+    random_attack, reverse_attack, drop_attack, lie_attack, empire_attack,
+    crash_attack,
+}
+
+
+def _resolve_gradient_attack(attack, key):
+    """Shared dispatch: name -> fn, with the needs-key check."""
+    if attack not in gradient_attacks:
+        raise ValueError(
+            f"unknown attack {attack!r}; available: {sorted(gradient_attacks)}"
+        )
+    fn = gradient_attacks[attack]
+    if fn in _NEEDS_KEY and key is None:
+        raise ValueError(f"attack {attack!r} needs a PRNG key")
+    return fn
+
 
 def apply_gradient_attack(attack, gradients, byz_mask, *, key=None, **params):
     """Rewrite the Byzantine rows of a (n, d) gradient stack.
@@ -127,17 +152,46 @@ def apply_gradient_attack(attack, gradients, byz_mask, *, key=None, **params):
     """
     if attack is None or attack == "none":
         return gradients
-    if attack not in gradient_attacks:
-        raise ValueError(
-            f"unknown attack {attack!r}; available: {sorted(gradient_attacks)}"
-        )
-    fn = gradient_attacks[attack]
+    fn = _resolve_gradient_attack(attack, key)
     mask = jnp.asarray(byz_mask, dtype=bool)
-    if fn in (random_attack, drop_attack):
-        if key is None:
-            raise ValueError(f"attack {attack!r} needs a PRNG key")
+    if fn in _NEEDS_KEY:
         return fn(gradients, mask, key=key, **params)
     return fn(gradients, mask, **params)
+
+
+def apply_gradient_attack_tree(attack, grads_tree, byz_mask, *, key=None,
+                               **params):
+    """Tree-mode twin of ``apply_gradient_attack``: poison the Byzantine rows
+    of a stacked gradient TREE (leading n axis per leaf) leaf by leaf.
+
+    Every gradient attack is coordinate-wise given the cohort row statistics,
+    and lie/empire's mu/sigma are per-coordinate masked reductions — so
+    applying the (n, d)-stack attack to each leaf reshaped to (n, size) is
+    semantically identical to flattening first. Randomized attacks fold the
+    key per leaf, so their draws differ from the flat path bitwise but not in
+    distribution. Used by the tree-mode GAR fast path
+    (parallel/aggregathor.py; PERF.md).
+    """
+    if attack is None or attack == "none":
+        return grads_tree
+    fn = _resolve_gradient_attack(attack, key)
+    if fn not in _COORDINATE_WISE:
+        raise ValueError(
+            f"attack {attack!r} is not coordinate-wise; per-leaf application "
+            "would use wrong cohort statistics — use the flat path"
+        )
+    mask = jnp.asarray(byz_mask, dtype=bool)
+
+    leaves, treedef = jax.tree.flatten(grads_tree)
+    out = []
+    for i, leaf in enumerate(leaves):
+        n = leaf.shape[0]
+        flat = leaf.reshape(n, -1)
+        kw = dict(params)
+        if fn in _NEEDS_KEY:
+            kw["key"] = jax.random.fold_in(key, i)
+        out.append(fn(flat, mask, **kw).reshape(leaf.shape))
+    return jax.tree.unflatten(treedef, out)
 
 
 # --- model attacks (byzServer.py:86-108) -----------------------------------

@@ -33,7 +33,11 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import aggregators
-from ..attacks import apply_gradient_attack, gradient_attacks
+from ..attacks import (
+    apply_gradient_attack,
+    apply_gradient_attack_tree,
+    gradient_attacks,
+)
 from . import core, mesh as mesh_lib
 
 __all__ = ["make_trainer"]
@@ -90,6 +94,7 @@ def make_trainer(
     axis="workers",
     subset=None,
     granularity="model",
+    tree_path=True,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the SSMW topology.
 
@@ -98,6 +103,10 @@ def make_trainer(
     actual fault injection (byzWorker.py); ``subset=q`` emulates the
     asynchronous wait-for-q path (server.py:134-155); ``granularity`` picks
     whole-model (trainer.py:236) vs per-layer (Garfield_CC) aggregation.
+    ``tree_path`` (default on) lets rules that support tree-mode aggregation
+    (average, krum) skip the (n, d) flat stack entirely — measured ~5 ms/
+    step at ResNet-18 scale (PERF.md); set False to force the flat path
+    (A/B tests).
 
     ``step_fn(state, x, y) -> (state, metrics)`` expects ``x``/``y`` with a
     leading ``num_workers`` axis, sharded over ``axis``; it is jit'd with
@@ -147,9 +156,16 @@ def make_trainer(
         slot_ids = shard_idx * per_shard + jnp.arange(per_shard)
         drop_keys = jax.vmap(lambda i: jax.random.fold_in(drop_base, i))(slot_ids)
 
-        grads_local, (loss_local, ms_local) = jax.vmap(
-            grad_fn, in_axes=(None, None, 0, 0, 0)
-        )(params, ms, x_local, y_local, drop_keys)
+        # Unrolled (not vmapped) per-slot gradients: kills the 5-D relayout
+        # tax of the logical-worker fold (core.per_slot_grads docstring).
+        # Keep the stacked TREE here and flatten after the gather — raveling
+        # each slot inside the unroll (flat=True) measured 12% SLOWER
+        # end-to-end (55 vs 62 steps/s): the 8 per-slot concats serialize
+        # against the fwd+bwd graphs, while one vmapped ravel of the stacked
+        # leaves fuses cleanly.
+        grads_local, (loss_local, ms_local) = core.per_slot_grads(
+            grad_fn, params, ms, x_local, y_local, drop_keys
+        )
 
         # all_gather over the mesh axis == Server.get_gradients (RPC gather).
         grads = jax.tree.map(
@@ -165,10 +181,27 @@ def make_trainer(
             attack=attack, attack_params=attack_params, gar=gar, f=f,
             subset=subset,
         )
-        if granularity == "layer":
+        if (
+            tree_path
+            and granularity != "layer"
+            and gar.tree_aggregate is not None
+        ):
+            # Tree-mode fast path: poison rows leaf-wise, aggregate without
+            # ever materializing the (n, d) flat stack (PERF.md: the
+            # flatten + unflatten round trip costs ~5 ms/step at ResNet-18
+            # scale on one chip).
+            poisoned = apply_gradient_attack_tree(
+                attack, grads, byz_mask, key=atk_key, **attack_params
+            )
+            if subset is not None and subset < num_workers:
+                sel = core.subset_indices(sub_key, num_workers, subset)
+                poisoned = jax.tree.map(lambda l: l[sel], poisoned)
+            aggr_tree = gar.tree_aggregate(poisoned, f=f, key=gar_key)
+        elif granularity == "layer":
             # Garfield_CC per-parameter aggregation: independent GAR (and
             # attack statistics) per tensor, like the reference's per-layer
-            # gather->GAR loop (Garfield_CC/trainer.py:91-127).
+            # gather->GAR loop (Garfield_CC/trainer.py:91-127). Each leaf is
+            # reshaped in place (free) — no flat stack is built.
             leaves, treedef = jax.tree.flatten(grads)
             out_leaves = []
             for i, leaf in enumerate(leaves):
@@ -182,7 +215,7 @@ def make_trainer(
                 out_leaves.append(aggr.reshape(leaf.shape[1:]))
             aggr_tree = jax.tree.unflatten(treedef, out_leaves)
         else:
-            flat_stack = core.flatten_rows(grads)
+            flat_stack = core.flatten_rows(grads)  # (n_w, d)
             aggr = _attack_then_aggregate(
                 flat_stack, byz_mask, atk_key, sub_key, gar_key, **agg_kwargs
             )

@@ -172,9 +172,9 @@ def make_trainer(
                     jax.random.fold_in(drop_base, ps_local_idx), i
                 )
             )(slot_ids)
-            g, (loss, ms_out) = jax.vmap(
-                grad_fn, in_axes=(None, None, 0, 0, 0)
-            )(params, ms, x_local, y_local, keys)
+            g, (loss, ms_out) = core.per_slot_grads(
+                grad_fn, params, ms, x_local, y_local, keys
+            )
             flat = core.flatten_rows(g)  # (per_w, d)
             stack = jax.lax.all_gather(flat, axis, tiled=True)  # (n_w, d)
             return stack, loss, ms_out

@@ -143,6 +143,40 @@ def segmented_aggregate(agg_fn, stack, segments):
     )
 
 
+# Above this many logical slots per shard, per-slot gradients fall back to
+# vmap: the unroll duplicates the model's fwd+bwd graph per slot and compile
+# time grows linearly. (On a real multi-chip mesh per-shard slot counts are
+# 1-2 and the unroll is always used.)
+UNROLL_MAX_SLOTS = 16
+
+
+def per_slot_grads(grad_fn, params, ms, x, y, keys):
+    """Per-slot gradients over a leading logical-slot axis, vmap-compatible.
+
+    Returns exactly what ``jax.vmap(grad_fn, in_axes=(None, None, 0, 0, 0))``
+    returns — ``(grads, (loss, ms))`` trees with a leading slot axis — but
+    computed by a Python unroll over the slots when their count is small.
+
+    Why: folding n logical workers onto one chip via vmap batches every
+    intermediate into 5-D (slot, batch, H, W, C) tensors, and XLA inserts
+    relayout copies/permuted slices around the ResNet family's convs — a
+    measured 36-63% tax (PERF.md "Known frontier"; 12.9 vs 9.1 ms for the
+    8-worker ResNet-18 gradient stack on the v5e chip). The unroll keeps
+    every subgraph 4-D and batch-minor; XLA schedules the independent
+    per-slot fwd+bwd graphs without the relayouts. lax.scan was measured
+    2.6x worse (sequential small batches), the patches-einsum custom VJP
+    3-6x worse, and raveling each slot inside the unroll 12% worse
+    end-to-end (PERF.md) — the plain unroll + stacked tree is the fix.
+    """
+    n = x.shape[0]
+    if n > UNROLL_MAX_SLOTS:
+        return jax.vmap(grad_fn, in_axes=(None, None, 0, 0, 0))(
+            params, ms, x, y, keys
+        )
+    outs = [grad_fn(params, ms, x[k], y[k], keys[k]) for k in range(n)]
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *outs)
+
+
 def subset_indices(key, n, q):
     """Uniformly sample q of n row indices (static shape (q,)).
 

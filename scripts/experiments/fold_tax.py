@@ -74,6 +74,20 @@ def build(variant, num_workers=8, batch=25, model="resnet18"):
                 return carry, (ravel_pytree(g)[0], loss)
             _, (flats, losses) = jax.lax.scan(body, 0, (x, y, keys))
             return flats, jnp.mean(losses)
+    elif variant.startswith("hybrid"):
+        # unroll groups x vmap(width) inside: hybrid2 = 4 groups of width 2.
+        width = int(variant[len("hybrid"):])
+        assert num_workers % width == 0
+        def step(params, ms, x, y):
+            flats, losses = [], []
+            for g0 in range(0, num_workers, width):
+                g, (loss, _) = jax.vmap(
+                    grad_fn, in_axes=(None, None, 0, 0, 0)
+                )(params, ms, x[g0:g0 + width], y[g0:g0 + width],
+                  keys[g0:g0 + width])
+                flats.append(core.flatten_rows(g))
+                losses.append(loss)
+            return jnp.concatenate(flats), jnp.mean(jnp.stack(losses))
     elif variant == "fused200":
         def step(params, ms, x, y):
             xf = x.reshape((-1,) + x.shape[2:])

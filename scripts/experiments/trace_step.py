@@ -1,0 +1,112 @@
+"""Capture an XLA device trace of the north-star train step and print the
+top device ops by total time.
+
+Parses the raw .xplane.pb with tsl's protobuf directly —
+tensorboard-plugin-profile's converter is broken against TF 2.20.
+
+  python scripts/experiments/trace_step.py [steps]
+"""
+
+import collections
+import glob
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _xplane_pb2():
+    for mod in (
+        "tensorflow.core.profiler.protobuf.xplane_pb2",
+        "tsl.profiler.protobuf.xplane_pb2",
+        "tensorflow.tsl.profiler.protobuf.xplane_pb2",
+    ):
+        try:
+            import importlib
+
+            return importlib.import_module(mod)
+        except Exception:
+            continue
+    raise ImportError("no xplane_pb2 found")
+
+
+def capture(step_fn, state, x, y, steps=5):
+    logdir = tempfile.mkdtemp(prefix="garfield_trace_")
+    with jax.profiler.trace(logdir):
+        s = state
+        for _ in range(steps):
+            s, m = step_fn(s, x, y)
+        float(m["loss"])
+    paths = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+    return paths
+
+
+def summarize(path, top=30):
+    pb = _xplane_pb2()
+    space = pb.XSpace()
+    with open(path, "rb") as fp:
+        space.ParseFromString(fp.read())
+    rows = []
+    for plane in space.planes:
+        if "TPU" not in plane.name and "device" not in plane.name.lower():
+            continue
+        meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+        totals = collections.Counter()
+        counts = collections.Counter()
+        for line in plane.lines:
+            for ev in line.events:
+                name = meta.get(ev.metadata_id, str(ev.metadata_id))
+                totals[name] += ev.duration_ps
+                counts[name] += 1
+        if not totals:
+            continue
+        rows.append((plane.name, totals, counts))
+    for plane_name, totals, counts in rows:
+        total_ms = sum(totals.values()) / 1e9
+        print(f"\n=== {plane_name}  (total {total_ms:.2f} ms) ===")
+        for name, ps in totals.most_common(top):
+            print(f"{ps / 1e9:9.3f} ms  x{counts[name]:<4} {name[:110]}")
+
+
+def main():
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+
+    import optax
+
+    from garfield_tpu import models
+    from garfield_tpu.parallel import aggregathor, mesh as mesh_lib
+    from garfield_tpu.utils import selectors
+
+    module = models.select_model("resnet18", "cifar10", dtype=jnp.bfloat16)
+    loss_fn = selectors.select_loss("cross-entropy")
+    opt = selectors.select_optimizer(
+        "sgd", lr=0.2, momentum=0.9, weight_decay=5e-4
+    )
+    mesh = mesh_lib.make_mesh({"workers": 1}, devices=jax.devices()[:1])
+    init_fn, step_fn, _ = aggregathor.make_trainer(
+        module, loss_fn, opt, "krum", num_workers=8, f=2, attack="lie",
+        mesh=mesh,
+    )
+    rng = np.random.default_rng(1234)
+    x = jnp.asarray(rng.standard_normal((8, 25, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (8, 25)), jnp.int32)
+    state = init_fn(jax.random.PRNGKey(1234), x[0])
+    for _ in range(3):
+        state, m = step_fn(state, x, y)
+    float(m["loss"])
+
+    paths = capture(step_fn, state, x, y, steps)
+    print("xplane files:", paths)
+    for p in paths:
+        summarize(p)
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,33 @@ class TestAggregathor:
         state, losses = _run(step_fn, state, x, y, 30)
         assert losses[-1] < losses[0] * 0.7
 
+    @pytest.mark.parametrize("gar,attack,f,subset", [
+        ("krum", "lie", 2, None),
+        ("krum", "reverse", 2, 7),
+        ("average", "empire", 2, None),
+        ("average", None, 0, None),
+    ])
+    def test_tree_path_matches_flat_path(self, gar, attack, f, subset):
+        """The tree-mode fast path (no flat (n, d) stack) must produce the
+        same training trajectory as the flat path for every deterministic
+        attack/GAR/subset combination it serves."""
+        module, loss, opt = _pima_setup()
+        x, y = _pima_batches(8, 16)
+        runs = []
+        for tree_path in (True, False):
+            init_fn, step_fn, _ = aggregathor.make_trainer(
+                module, loss, opt, gar, num_workers=8, f=f, attack=attack,
+                subset=subset, tree_path=tree_path,
+            )
+            state = init_fn(jax.random.PRNGKey(0), x[0])
+            state, losses = _run(step_fn, state, x, y, 5)
+            runs.append((losses, jax.device_get(state.params)))
+        np.testing.assert_allclose(runs[0][0], runs[1][0], rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+            runs[0][1], runs[1][1],
+        )
+
     def test_krum_resists_reverse_attack(self):
         # Under the x-100 reverse attack (byzWorker.py:87-94), plain average
         # diverges while Krum stays stable — the core Garfield claim.
