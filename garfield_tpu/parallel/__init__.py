@@ -36,6 +36,7 @@ __all__ = [
     "make_mesh",
     "topologies",
     "compute_accuracy",
+    "compute_accuracy_async",
 ]
 
 topologies = {
@@ -47,6 +48,31 @@ topologies = {
 }
 
 
+def _accuracy_counts(state, eval_fn, test_batches, *, binary=False):
+    """Enqueue the full eval pass; return (correct, total) with ``correct``
+    a DEVICE scalar — no host synchronization happens here.
+
+    The per-batch compare+sum runs on device, so the caller decides when to
+    pay the host readback (which on tunneled backends costs ~0.1 s per
+    conversion — the old per-batch ``np.asarray`` made inline eval stall
+    the step stream for seconds).
+    """
+    correct = jnp.zeros((), jnp.int32)
+    total = 0
+    for x, y in test_batches:
+        logits = eval_fn(state, jnp.asarray(x))
+        y_np = np.asarray(y).reshape(-1)
+        yj = jnp.asarray(y_np)
+        if binary:
+            # pima path: sigmoid output, threshold 0.5 (demo.py accuracy).
+            pred = (logits.reshape(-1) > 0.5).astype(yj.dtype)
+            correct = correct + jnp.sum(pred == yj)
+        else:
+            correct = correct + jnp.sum(logits.argmax(-1) == yj)
+        total += int(y_np.shape[0])
+    return correct, total
+
+
 def compute_accuracy(state, eval_fn, test_batches, *, binary=False):
     """Top-1 accuracy over a list of (x, y) test batches.
 
@@ -54,15 +80,47 @@ def compute_accuracy(state, eval_fn, test_batches, *, binary=False):
     ``compute_accuracy`` (tensorflow_impl/libs/server.py:152-163). ``binary``
     follows the pima path (single sigmoid logit, byzWorker-era threshold 0.5).
     """
-    correct = total = 0
-    for x, y in test_batches:
-        logits = np.asarray(eval_fn(state, jnp.asarray(x)))
-        y = np.asarray(y)
-        if binary:
-            # pima path: sigmoid output, threshold 0.5 (demo.py accuracy).
-            pred = (logits.reshape(-1) > 0.5).astype(y.dtype)
-            correct += int((pred == y.reshape(-1)).sum())
-        else:
-            correct += int((logits.argmax(-1) == y.reshape(-1)).sum())
-        total += len(y)
-    return correct / max(total, 1)
+    correct, total = _accuracy_counts(
+        state, eval_fn, test_batches, binary=binary
+    )
+    return int(correct) / max(total, 1)
+
+
+def compute_accuracy_async(state, eval_fn, test_batches, *, binary=False,
+                           on_done=None, after=None):
+    """Overlapped accuracy: enqueue the eval pass now, pay the host readback
+    in a side thread — the SPMD analog of the reference's accuracy thread
+    (Aggregathor/trainer.py:251-264).
+
+    All device work is dispatched synchronously in the caller's thread
+    BEFORE returning, so a subsequent donating ``step_fn(state)`` call is
+    safe: the enqueued eval executions already hold their buffer references
+    and are sequenced ahead of the donated step on the device stream. Only
+    the blocking scalar conversion moves off the training thread.
+
+    ``after``: a previous thread from this function; the new thread waits
+    for it before reporting, so successive reports stay in request order.
+    Returns the started (daemon) thread; its ``.exc`` attribute holds any
+    exception the readback or ``on_done`` raised — join it and re-raise at
+    exit, or the failure is silently dropped.
+    """
+    import threading
+
+    correct, total = _accuracy_counts(
+        state, eval_fn, test_batches, binary=binary
+    )
+
+    def _finalize():
+        try:
+            if after is not None:
+                after.join()
+            acc = int(correct) / max(total, 1)  # the one host readback
+            if on_done is not None:
+                on_done(acc)
+        except BaseException as exc:  # surfaced by the caller at join
+            t.exc = exc
+
+    t = threading.Thread(target=_finalize, daemon=True)
+    t.exc = None
+    t.start()
+    return t

@@ -37,6 +37,27 @@ def test_aggregathor_krum_lie():
     assert int(state.step) == 3
 
 
+def test_async_eval_matches_sync(capsys):
+    """Overlapped accuracy (the default, mirroring the reference's side
+    thread at Aggregathor/trainer.py:251-264) must report the same values
+    as the inline --sync_eval path, and all reports must flush before the
+    summary line."""
+    flags = FAST + ["--num_workers", "8", "--gar", "average"]
+    outs = []
+    for mode in ([], ["--sync_eval"]):
+        app_aggregathor.main(flags + mode)
+        lines = capsys.readouterr().out.splitlines()
+        # Strip the wall-clock suffix: only epoch + accuracy must match.
+        accs = [l.split(" Time:")[0] for l in lines if l.startswith("Epoch:")]
+        summary_idx = max(
+            i for i, l in enumerate(lines) if l.startswith("Epoch:")
+        )
+        assert any(l.startswith('{"tag"') for l in lines[summary_idx:])
+        outs.append(accs)
+    assert outs[0] == outs[1]
+    assert len(outs[0]) >= 2  # acc_freq=2 over 3 iters -> evals at 0 and 2
+
+
 def test_aggregathor_subset_and_layer_granularity():
     _, summary = app_aggregathor.main(
         FAST + ["--num_workers", "8", "--fw", "1", "--gar", "median",
