@@ -67,6 +67,23 @@ def main(config_path):
     print(f"AGG {pid} {float(out.sum()):.6f} {float(np.abs(out).max()):.6f}",
           flush=True)
 
+    # Host-level wait-n-f exchange (T1/T2/T9 live path): publish this host's
+    # serialized aggregate over TCP, block on the native MRMW register for
+    # the peer's, and verify both hosts hold the identical replicated result
+    # — the DCN analog of ByzSGD's model gather (server.py:161-184).
+    ex_hosts = cfg.garfield.get("exchange")
+    if ex_hosts:
+        from garfield_tpu.utils.exchange import PeerExchange
+
+        with PeerExchange(pid, ex_hosts) as ex:
+            ex.publish(0, out.tobytes())
+            got = ex.collect(0, q=len(ex_hosts), timeout_ms=60_000)
+        peers_equal = all(
+            np.array_equal(np.frombuffer(p, np.float32), out)
+            for p in got.values()
+        )
+        print(f"EXCHANGE {pid} ok={peers_equal} n={len(got)}", flush=True)
+
 
 if __name__ == "__main__":
     main(sys.argv[1])

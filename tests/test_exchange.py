@@ -1,0 +1,118 @@
+"""PeerExchange: host-level wait-n-f over TCP + the native MRMW register.
+
+These are the tests that fail if MultiBuffer breaks in a way a user feels
+(VERDICT r1 #8): the exchange's blocking rendezvous IS the register —
+frames land via ``write``, ``collect`` wakes via ``read(min_version)``.
+Three peers run in one process on localhost ports; the cross-process case
+is covered by tests/test_multihost_integration.py.
+"""
+
+import socket
+
+import pytest
+
+pytest.importorskip("garfield_tpu.native")
+from garfield_tpu import native
+
+if native.load() is None:  # no compiler / native runtime in this env
+    pytest.skip("native runtime unavailable", allow_module_level=True)
+
+from garfield_tpu.utils.exchange import PeerExchange
+
+
+def _ports(k):
+    socks = [socket.socket() for _ in range(k)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _mesh(n):
+    hosts = [f"127.0.0.1:{p}" for p in _ports(n)]
+    return [PeerExchange(i, hosts) for i in range(n)]
+
+
+def test_all_publish_all_collect():
+    peers = _mesh(3)
+    try:
+        for step in range(3):  # versions advance across steps
+            for p in peers:
+                p.publish(step, f"s{step}p{p.my_index}".encode())
+            for p in peers:
+                got = p.collect(step, q=3, timeout_ms=10_000)
+                assert got == {
+                    i: f"s{step}p{i}".encode() for i in range(3)
+                }
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_wait_nf_excludes_straggler():
+    peers = _mesh(3)
+    try:
+        # Peer 2 never publishes: the q=2 quorum must return without it.
+        for p in peers[:2]:
+            p.publish(0, bytes([p.my_index]))
+        got = peers[0].collect(0, q=2, timeout_ms=10_000)
+        assert set(got) == {0, 1}
+        # ...and demanding all 3 times out (ps.py:84-88 bounded-wait exit).
+        with pytest.raises(TimeoutError):
+            peers[1].collect(0, q=3, timeout_ms=300)
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_overwritten_step_is_not_mixed_in():
+    """Exact-step semantics: once a peer's newer frame overwrites the
+    requested step in the last-writer-wins register, that peer cannot join
+    the quorum with wrong-iteration data — the collect times out instead."""
+    peers = _mesh(2)
+    try:
+        peers[0].publish(0, b"own-step0")
+        peers[1].publish(0, b"peer-step0")
+        peers[1].publish(1, b"peer-step1")  # overwrites step 0 in flight
+        # Wait until peer 1's frames have landed in peer 0's register.
+        import time
+
+        deadline = time.time() + 10
+        while peers[0]._mb.version(1) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        got = peers[0].collect(0, q=1, timeout_ms=5_000)
+        assert got == {0: b"own-step0"}  # own slot still holds step 0
+        with pytest.raises(TimeoutError):
+            peers[0].collect(0, q=2, timeout_ms=300)  # step 0 gone for peer 1
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_late_joiner_catches_up():
+    """A collect blocked on a not-yet-published step wakes when the frame
+    arrives — the blocking-read path of the register, no polling."""
+    import threading
+    import time
+
+    peers = _mesh(2)
+    try:
+        result = {}
+
+        def waiter():
+            result.update(peers[0].collect(5, q=2, timeout_ms=15_000))
+
+        peers[0].publish(5, b"self")
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)  # let the waiter block on the register
+        peers[1].publish(5, b"late")
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert result == {0: b"self", 1: b"late"}
+    finally:
+        for p in peers:
+            p.close()
