@@ -112,29 +112,45 @@ def _pad_cols(g, tile):
     return g, d
 
 
+def _load_rows(x_ref, n):
+    """Rows upcast to f32 in VMEM: Mosaic on current targets rejects bf16
+    compares ("Target does not support this comparison" — caught by the
+    on-device tests, tests/test_ops_tpu.py), and bf16 -> f32 is exact and
+    order-preserving, so the sort network is unchanged semantically while
+    HBM traffic stays bf16."""
+    return [x_ref[i, :].astype(jnp.float32) for i in range(n)]
+
+
 def _median_kernel(n, x_ref, o_ref):
-    rows = [x_ref[i, :] for i in range(n)]
-    rows = _oddeven_exchange(rows)
-    o_ref[0, :] = rows[(n - 1) // 2]
+    rows = _oddeven_exchange(_load_rows(x_ref, n))
+    o_ref[0, :] = rows[(n - 1) // 2].astype(o_ref.dtype)
 
 
 def _tmean_kernel(n, f, x_ref, o_ref):
-    rows = _oddeven_exchange([x_ref[i, :] for i in range(n)])
+    rows = _oddeven_exchange(_load_rows(x_ref, n))
     acc = rows[f]
     for i in range(f + 1, n - f):
         acc = acc + rows[i]
-    o_ref[0, :] = acc / (n - 2 * f)
+    o_ref[0, :] = (acc / (n - 2 * f)).astype(o_ref.dtype)
 
 
 def _avgmed_kernel(s, beta, x_ref, o_ref):
-    vals = [x_ref[i, :] for i in range(s)]
+    vals = _load_rows(x_ref, s)
     med = _oddeven_exchange(list(vals))[(s - 1) // 2]
-    devs = [jnp.abs(v - med) for v in vals]
+    # Deviations are the SORT KEYS and must carry the input dtype's
+    # rounding: the spec computes |g - med| in the input dtype, where bf16
+    # rounding creates ties (broken stably by row index) that exact f32
+    # deviations would order differently. Quantize, then upcast for the
+    # comparisons Mosaic supports.
+    devs = [
+        jnp.abs(v - med).astype(x_ref.dtype).astype(jnp.float32)
+        for v in vals
+    ]
     _, picked = _oddeven_exchange(devs, vals)
     acc = picked[0]
     for i in range(1, beta):
         acc = acc + picked[i]
-    o_ref[0, :] = acc / beta
+    o_ref[0, :] = (acc / beta).astype(o_ref.dtype)
 
 
 def _column_call(kernel, g, tile, interpret):

@@ -15,17 +15,27 @@ test might spawn.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# GARFIELD_TPU_TESTS=1 opts OUT of the CPU forcing so the real-TPU test
+# files (tests/test_ops_tpu.py — on-device Mosaic-lowering equality) run
+# against the chip; everything else skips itself off-CPU or on-TPU as
+# appropriate.
+_USE_TPU = os.environ.get("GARFIELD_TPU_TESTS", "").lower() not in (
+    "", "0", "false",
+)
+
+if not _USE_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if not _USE_TPU:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 # Persistent compilation cache: CPU test compiles of the large SPMD programs
 # dominate suite time; caching them across runs keeps the suite fast.
