@@ -1,0 +1,143 @@
+#!/usr/bin/env python3
+"""Fetch the real datasets into GARFIELD_TPU_DATA_DIR (default ~/data).
+
+The counterpart of the reference's automatic acquisition — torchvision
+``download=True`` (pytorch_impl/libs/garfieldpp/datasets.py:181-215) and the
+tfds percent-split loader (tensorflow_impl/libs/dataset.py:41-87). This repo
+runs in zero-egress environments, so acquisition is a separate, stdlib-only
+script for egress-enabled hosts; the library itself transparently falls back
+to the deterministic synthetic surrogate when files are absent
+(garfield_tpu/data/__init__.py).
+
+Produces exactly the layouts ``garfield_tpu.data`` reads:
+  mnist:    <root>/{train,t10k}-{images-idx3,labels-idx1}-ubyte.gz
+  cifar10:  <root>/cifar-10-batches-py/{data_batch_1..5,test_batch}
+  cifar100: <root>/cifar-100-python/{train,test}
+  pima:     <root>/pima_diabetes.csv   (header + 768 rows)
+
+Usage:
+  python scripts/fetch_data.py [--root DIR] [--datasets mnist cifar10 ...]
+"""
+
+import argparse
+import io
+import os
+import pathlib
+import sys
+import tarfile
+import urllib.request
+
+# Mirrors, first-hit-wins: the same sources torchvision's MNIST mirror list
+# and CIFAR download use (datasets.py:181-215 era), plus the canonical pima
+# CSV (the UCI original was withdrawn; this is the standard mirror).
+URLS = {
+    "mnist": [
+        ("https://storage.googleapis.com/cvdf-datasets/mnist/", [
+            "train-images-idx3-ubyte.gz",
+            "train-labels-idx1-ubyte.gz",
+            "t10k-images-idx3-ubyte.gz",
+            "t10k-labels-idx1-ubyte.gz",
+        ]),
+        ("https://ossci-datasets.s3.amazonaws.com/mnist/", [
+            "train-images-idx3-ubyte.gz",
+            "train-labels-idx1-ubyte.gz",
+            "t10k-images-idx3-ubyte.gz",
+            "t10k-labels-idx1-ubyte.gz",
+        ]),
+    ],
+    "cifar10": "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz",
+    "cifar100": "https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz",
+    "pima": ("https://raw.githubusercontent.com/jbrownlee/Datasets/master/"
+             "pima-indians-diabetes.data.csv"),
+}
+
+PIMA_HEADER = ("pregnancies,glucose,blood_pressure,skin_thickness,insulin,"
+               "bmi,diabetes_pedigree,age,outcome\n")
+
+
+def _urllib_download(url, timeout=120):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def fetch_mnist(root, download=_urllib_download):
+    """idx-ubyte .gz files straight into <root>/ (data/__init__ reads .gz)."""
+    last_err = None
+    for base, names in URLS["mnist"]:
+        try:
+            for name in names:
+                dest = root / name
+                if dest.exists():
+                    continue
+                dest.write_bytes(download(base + name))
+            return [root / n for n in URLS["mnist"][0][1]]
+        except Exception as exc:  # try the next mirror
+            last_err = exc
+    raise RuntimeError(f"all MNIST mirrors failed: {last_err}")
+
+
+def _extract_tar(raw, root, expect_prefix):
+    with tarfile.open(fileobj=io.BytesIO(raw), mode="r:gz") as tar:
+        for member in tar.getmembers():
+            if not member.name.startswith(expect_prefix):
+                raise RuntimeError(
+                    f"unexpected member {member.name!r} (want "
+                    f"{expect_prefix!r}/...)"
+                )
+        tar.extractall(root, filter="data")
+    return root / expect_prefix
+
+
+def fetch_cifar(root, name="cifar10", download=_urllib_download):
+    """Extract the python-pickle tarball into the layout the loader reads."""
+    prefix = "cifar-10-batches-py" if name == "cifar10" else "cifar-100-python"
+    if (root / prefix).exists():
+        return root / prefix
+    return _extract_tar(download(URLS[name]), root, prefix)
+
+
+def fetch_pima(root, download=_urllib_download):
+    """CSV with header (the loader does skip_header=1); the mirror ships
+    the raw 768 rows without one."""
+    dest = root / "pima_diabetes.csv"
+    if dest.exists():
+        return dest
+    body = download(URLS["pima"]).decode("utf-8").strip()
+    first = body.splitlines()[0]
+    if any(c.isalpha() for c in first):  # mirror already has a header
+        dest.write_text(body + "\n")
+    else:
+        dest.write_text(PIMA_HEADER + body + "\n")
+    return dest
+
+
+FETCHERS = {
+    "mnist": fetch_mnist,
+    "cifar10": lambda root, download=_urllib_download: fetch_cifar(
+        root, "cifar10", download),
+    "cifar100": lambda root, download=_urllib_download: fetch_cifar(
+        root, "cifar100", download),
+    "pima": fetch_pima,
+}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--root", type=str, default=os.environ.get(
+        "GARFIELD_TPU_DATA_DIR", str(pathlib.Path.home() / "data")))
+    p.add_argument("--datasets", nargs="*", default=sorted(FETCHERS))
+    args = p.parse_args(argv)
+    root = pathlib.Path(args.root)
+    root.mkdir(parents=True, exist_ok=True)
+    for name in args.datasets:
+        if name not in FETCHERS:
+            raise SystemExit(
+                f"unknown dataset {name!r}; available: {sorted(FETCHERS)}"
+            )
+        print(f"fetching {name} -> {root}", flush=True)
+        out = FETCHERS[name](root)
+        print(f"  ok: {out}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,168 @@
+"""Time-to-target-accuracy vs Byzantine count f (the north-star's second
+half, BASELINE.json).
+
+ResNet-18 / CIFAR-10 (real files when present under GARFIELD_TPU_DATA_DIR —
+see scripts/fetch_data.py — else the deterministic synthetic surrogate),
+9 workers x batch 25, Multi-Krum under the lie attack for f in {1, 2, 3}
+(n >= 2f+3 admits f <= 3 at n = 9) and fault-free average for f = 0,
+mirroring the reference experiment grid (Aggregathor/run_exp.sh:5-14,
+BASELINE.json configs).
+
+Records (wall_seconds, accuracy) curves and the first crossing of each
+target accuracy; writes the tracked artifact BASELINE_TTA.json and prints a
+markdown table for BASELINE.md.
+
+  python scripts/tta_bench.py [--iters 1200] [--eval_every 100] [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TARGETS = (0.5, 0.7, 0.9)
+
+
+def run_one(f, *, iters, eval_every, lr, gar=None, num_workers=9,
+            batch=25):
+    from garfield_tpu import data, models, parallel
+    from garfield_tpu.parallel import aggregathor, mesh as mesh_lib
+    from garfield_tpu.utils import selectors
+
+    platform = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    module = models.select_model("resnet18", "cifar10", dtype=dtype)
+    loss_fn = selectors.select_loss("cross-entropy")
+    opt = selectors.select_optimizer(
+        "sgd", lr=lr, momentum=0.9, weight_decay=5e-4
+    )
+    if gar is None:
+        gar = "krum" if f else "average"
+    attack = "lie" if f else None
+    mesh = mesh_lib.make_mesh({"workers": 1}, devices=jax.devices()[:1])
+    init_fn, step_fn, eval_fn = aggregathor.make_trainer(
+        module, loss_fn, opt, gar,
+        num_workers=num_workers, f=f, attack=attack, mesh=mesh,
+    )
+
+    manager = data.DatasetManager("cifar10", batch, num_workers, num_workers, 0)
+    manager.num_ps = 0
+    xs_np, ys_np = manager.sharded_train_batches()
+    # Bounded eval cost per point, scanned as ONE program (parallel.EvalSet).
+    test = parallel.EvalSet(manager.get_test_set()[:40])
+    xs, ys = jnp.asarray(xs_np), jnp.asarray(ys_np)
+    num_batches = xs.shape[1]
+
+    state = init_fn(jax.random.PRNGKey(1234), xs_np[0, 0])
+    state, m = step_fn(state, xs[:, 0], ys[:, 0])  # compile before the clock
+    jax.block_until_ready(m["loss"])
+
+    curve = []
+    t0 = time.time()
+    for i in range(iters):
+        state, m = step_fn(state, xs[:, i % num_batches], ys[:, i % num_batches])
+        if (i + 1) % eval_every == 0 or i + 1 == iters:
+            acc = parallel.compute_accuracy(state, eval_fn, test)
+            curve.append({"wall_s": round(time.time() - t0, 3),
+                          "step": i + 1, "accuracy": round(acc, 4)})
+            print(f"  f={f} step={i + 1:5d} wall={curve[-1]['wall_s']:7.2f}s "
+                  f"acc={acc:.4f}", flush=True)
+    tta = {}
+    for tgt in TARGETS:
+        hit = next((c for c in curve if c["accuracy"] >= tgt), None)
+        tta[str(tgt)] = None if hit is None else hit["wall_s"]
+    return {"f": f, "gar": gar, "attack": attack,
+            "num_workers": num_workers, "batch": batch,
+            "final_accuracy": curve[-1]["accuracy"] if curve else None,
+            "time_to_target_s": tta, "curve": curve}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--iters", type=int, default=1200)
+    p.add_argument("--eval_every", type=int, default=100)
+    p.add_argument("--fs", nargs="*", type=int, default=[0, 1, 2, 3])
+    p.add_argument("--gar", type=str, default=None,
+                   help="Override the rule (default: krum for f>0, "
+                        "average for f=0); e.g. bulyan needs n >= 4f+3.")
+    p.add_argument("--workers", type=int, default=9)
+    p.add_argument("--lr", type=float, default=0.05,
+                   help="SGD lr; the reference 0.2 makes krum-vs-lie at "
+                   "f>=2 oscillate without converging on this task — "
+                   "0.05 yields comparable convergence across f.")
+    p.add_argument("--out", type=str, default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BASELINE_TTA.json"))
+    args = p.parse_args(argv)
+
+    from garfield_tpu import data as data_lib
+
+    real = (data_lib.data_dir() / "cifar-10-batches-py").exists()
+    results = []
+    for f in args.fs:
+        print(f"=== f={f} ===", flush=True)
+        results.append(run_one(
+            f, iters=args.iters, eval_every=args.eval_every, lr=args.lr,
+            gar=args.gar, num_workers=args.workers,
+        ))
+    artifact = {
+        "config": "resnet18/cifar10, 9 workers x batch 25, krum+lie (f>0) "
+                  f"or average (f=0), SGD lr {args.lr} m 0.9 wd 5e-4",
+        "data": "real cifar10 files" if real else
+                "deterministic synthetic surrogate (no dataset files; see "
+                "scripts/fetch_data.py)",
+        "device": str(jax.devices()[0]),
+        "results": results,
+    }
+    # Merge with a prior artifact so the sweep can be built one f at a time
+    # (each run is minutes on the shared chip).
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as fp:
+                prior = json.load(fp)
+        except (OSError, ValueError) as exc:
+            print(f"warning: cannot merge prior artifact ({exc}); "
+                  f"overwriting {args.out}", file=sys.stderr)
+        else:
+            # .get defaults keep hand-edited / older-schema rows mergeable
+            # instead of silently destroying them.
+            key = lambda r: (r.get("f"), r.get("gar"), r.get("num_workers"))
+            done = {key(r) for r in results}
+            artifact["results"] = sorted(
+                results + [
+                    r for r in prior.get("results", [])
+                    if key(r) not in done
+                ],
+                key=lambda r: (r.get("f", 0), str(r.get("gar")),
+                               r.get("num_workers", 0)),
+            )
+    results = artifact["results"]
+    with open(args.out, "w") as fp:
+        json.dump(artifact, fp, indent=1)
+    print(f"\nwrote {args.out}\n")
+    print("| f | gar/attack | final acc | " +
+          " | ".join(f"t(acc>={t})" for t in TARGETS) + " |")
+    print("|---" * (3 + len(TARGETS)) + "|")
+    for r in results:
+        tta = r["time_to_target_s"]
+        cells = " | ".join(
+            "-" if tta[str(t)] is None else f"{tta[str(t)]:.1f}s"
+            for t in TARGETS
+        )
+        print(f"| {r['f']} (n={r['num_workers']}) | {r['gar']}"
+              f"{'+' + r['attack'] if r['attack'] else ''} | "
+              f"{r['final_accuracy']:.4f} | {cells} |")
+    return artifact
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,131 @@
+"""scripts/fetch_data.py offline format-correctness (VERDICT r1 #5).
+
+No egress in this environment, so the download step is injected: the fake
+downloader produces byte-exact artifacts in the upstream formats (idx-ubyte
+gz, python-pickle tarballs, headerless CSV), and the REAL loaders in
+garfield_tpu.data must then read the fetched tree — proving the script's
+layouts/URLs line up with what the library expects.
+"""
+
+import gzip
+import importlib.util
+import io
+import os
+import pickle
+import struct
+import sys
+import tarfile
+
+import numpy as np
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "fetch_data",
+    os.path.join(os.path.dirname(__file__), "..", "scripts", "fetch_data.py"),
+)
+fetch_data = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(fetch_data)
+
+
+def _idx_gz(array):
+    """Encode an array in idx-ubyte format, gzipped (the MNIST wire format)."""
+    array = np.asarray(array, np.uint8)
+    magic = 0x0800 | array.ndim
+    header = struct.pack(">i", magic) + b"".join(
+        struct.pack(">i", s) for s in array.shape
+    )
+    return gzip.compress(header + array.tobytes())
+
+
+def _mnist_downloader(url, **_):
+    rng = np.random.default_rng(0)
+    if "images" in url:
+        n = 64 if "train" in url else 16
+        return _idx_gz(rng.integers(0, 256, (n, 28, 28)))
+    n = 64 if "train" in url else 16
+    return _idx_gz(rng.integers(0, 10, (n,)))
+
+
+def _cifar_downloader(url, **_):
+    rng = np.random.default_rng(1)
+
+    def batch(n, label_key):
+        return pickle.dumps({
+            b"data": rng.integers(0, 256, (n, 3072), dtype=np.uint8),
+            label_key: rng.integers(0, 10, n).tolist(),
+        })
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        if "cifar-100" not in url:
+            names = [f"cifar-10-batches-py/data_batch_{i}" for i in
+                     range(1, 6)] + ["cifar-10-batches-py/test_batch"]
+            key = b"labels"
+        else:
+            names = ["cifar-100-python/train", "cifar-100-python/test"]
+            key = b"fine_labels"
+        for name in names:
+            payload = batch(8, key)
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+    return buf.getvalue()
+
+
+def _pima_downloader(url, **_):
+    rng = np.random.default_rng(2)
+    rows = [
+        ",".join(
+            [f"{v:.1f}" for v in rng.normal(size=8)]
+            + [str(int(rng.integers(0, 2)))]
+        )
+        for _ in range(768)
+    ]
+    return ("\n".join(rows)).encode()  # headerless, like the mirror
+
+
+def test_urls_are_wellformed():
+    from urllib.parse import urlparse
+
+    flat = []
+    for v in fetch_data.URLS.values():
+        if isinstance(v, str):
+            flat.append(v)
+        else:
+            flat += [base for base, _ in v]
+    for url in flat:
+        parsed = urlparse(url)
+        assert parsed.scheme == "https" and parsed.netloc, url
+
+
+def test_fetched_mnist_loads(tmp_path, monkeypatch):
+    fetch_data.fetch_mnist(tmp_path, download=_mnist_downloader)
+    monkeypatch.setenv("GARFIELD_TPU_DATA_DIR", str(tmp_path))
+    from garfield_tpu import data
+
+    (tx, ty), (vx, vy) = data.load_mnist()
+    assert tx.shape == (64, 28, 28, 1) and vx.shape == (16, 28, 28, 1)
+    assert ty.dtype == np.int32 and set(np.unique(ty)) <= set(range(10))
+
+
+@pytest.mark.parametrize("name", ["cifar10", "cifar100"])
+def test_fetched_cifar_loads(tmp_path, monkeypatch, name):
+    fetch_data.fetch_cifar(tmp_path, name, download=_cifar_downloader)
+    monkeypatch.setenv("GARFIELD_TPU_DATA_DIR", str(tmp_path))
+    from garfield_tpu import data
+
+    (tx, ty), (vx, vy) = data.load_cifar(name, augment_train=False)
+    assert tx.shape[1:] == (32, 32, 3) and vx.shape[1:] == (32, 32, 3)
+    assert tx.shape[0] == 40 if name == "cifar10" else 8
+
+
+def test_fetched_pima_loads(tmp_path, monkeypatch):
+    dest = fetch_data.fetch_pima(tmp_path, download=_pima_downloader)
+    # The loader does skip_header=1, so the script must have added one.
+    assert dest.read_text().splitlines()[0].startswith("pregnancies,")
+    monkeypatch.setenv("GARFIELD_TPU_DATA_DIR", str(tmp_path))
+    from garfield_tpu import data
+
+    (tx, ty), (vx, vy) = data.load_pima()
+    assert tx.shape == (600, 8) and vx.shape == (168, 8)
+    assert ty.shape == (600, 1) and ty.dtype == np.float32
