@@ -240,6 +240,10 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     declared_f = make_trainer_kwargs.get("f", make_trainer_kwargs.get("fw", 0))
     sched = _crash_schedule(args, num_slots, declared_f)
     xs_np, ys_np, test_batches, iters_per_epoch = load_data(args, num_slots)
+    binary = args.dataset == "pima"
+    # One scanned eval program over the device-stacked test set instead of
+    # one dispatch per batch (parallel.EvalSet docstring).
+    test_batches = parallel.EvalSet(test_batches, binary=binary)
     tools.info(
         f"[{tag}] One EPOCH consists of {iters_per_epoch} iterations"
     )
@@ -285,7 +289,6 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
 
     timer = profiling.StepTimer()
     d = int(sum(np.prod(l.shape) for l in jax.tree.leaves(state.params)))
-    binary = args.dataset == "pima"
     num_batches = xs.shape[1]
     metrics = {}
 

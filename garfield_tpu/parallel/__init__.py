@@ -35,6 +35,7 @@ __all__ = [
     "make_worker_fns",
     "make_mesh",
     "topologies",
+    "EvalSet",
     "compute_accuracy",
     "compute_accuracy_async",
 ]
@@ -48,6 +49,66 @@ topologies = {
 }
 
 
+class EvalSet:
+    """Device-stacked test set evaluated by ONE jitted scanned program.
+
+    The list-of-batches eval path dispatches one program per test batch
+    (hundreds for MNIST/CIFAR) — each dispatch costs real latency on a
+    tunneled backend. EvalSet uploads the stacked (B, bsz, ...) arrays once
+    and folds the whole accuracy count into a single ``lax.scan`` program
+    per ``eval_fn``. Pass it anywhere ``test_batches`` is accepted.
+    """
+
+    def __init__(self, test_batches, *, binary=False):
+        # DatasetManager keeps the ragged tail batch (data/__init__.py), so
+        # stack the uniform prefix and keep differently-shaped stragglers on
+        # a per-batch side path.
+        batches = [
+            (jnp.asarray(x), jnp.asarray(np.asarray(y).reshape(-1)))
+            for x, y in test_batches
+        ]
+        shape0 = batches[0][0].shape if batches else None
+        uniform = [b for b in batches if b[0].shape == shape0]
+        self.ragged = [b for b in batches if b[0].shape != shape0]
+        self.xs = jnp.stack([x for x, _ in uniform])
+        self.ys = jnp.stack([y for _, y in uniform])
+        self.binary = binary
+        self.total = int(self.ys.size) + sum(
+            int(y.size) for _, y in self.ragged
+        )
+        self._jitted = {}
+
+    def _batch_hits(self, state, eval_fn, x, y):
+        logits = eval_fn(state, x)
+        if self.binary:
+            pred = (logits.reshape(-1) > 0.5).astype(y.dtype)
+            return jnp.sum(pred == y).astype(jnp.int32)
+        return jnp.sum(logits.argmax(-1) == y).astype(jnp.int32)
+
+    def counts(self, state, eval_fn):
+        """(correct device scalar, total) — no host sync."""
+        key = id(eval_fn)
+        fn = self._jitted.get(key)
+        if fn is None:
+
+            def count(state, xs, ys):
+                def body(correct, xy):
+                    x, y = xy
+                    return correct + self._batch_hits(state, eval_fn, x, y), None
+
+                correct, _ = jax.lax.scan(
+                    body, jnp.zeros((), jnp.int32), (xs, ys)
+                )
+                return correct
+
+            fn = jax.jit(count)
+            self._jitted[key] = fn
+        correct = fn(state, self.xs, self.ys)
+        for x, y in self.ragged:
+            correct = correct + self._batch_hits(state, eval_fn, x, y)
+        return correct, self.total
+
+
 def _accuracy_counts(state, eval_fn, test_batches, *, binary=False):
     """Enqueue the full eval pass; return (correct, total) with ``correct``
     a DEVICE scalar — no host synchronization happens here.
@@ -55,8 +116,11 @@ def _accuracy_counts(state, eval_fn, test_batches, *, binary=False):
     The per-batch compare+sum runs on device, so the caller decides when to
     pay the host readback (which on tunneled backends costs ~0.1 s per
     conversion — the old per-batch ``np.asarray`` made inline eval stall
-    the step stream for seconds).
+    the step stream for seconds). ``test_batches`` may be an ``EvalSet``
+    (one scanned program) or a list of (x, y) batches.
     """
+    if isinstance(test_batches, EvalSet):
+        return test_batches.counts(state, eval_fn)
     correct = jnp.zeros((), jnp.int32)
     total = 0
     for x, y in test_batches:
@@ -92,11 +156,14 @@ def compute_accuracy_async(state, eval_fn, test_batches, *, binary=False,
     in a side thread — the SPMD analog of the reference's accuracy thread
     (Aggregathor/trainer.py:251-264).
 
-    All device work is dispatched synchronously in the caller's thread
-    BEFORE returning, so a subsequent donating ``step_fn(state)`` call is
-    safe: the enqueued eval executions already hold their buffer references
-    and are sequenced ahead of the donated step on the device stream. Only
-    the blocking scalar conversion moves off the training thread.
+    All device work is dispatched AND completed (``block_until_ready``)
+    in the caller's thread before returning: a donating ``step_fn(state)``
+    call issued while eval consumers of ``state`` are still pending ABORTS
+    the XLA:CPU runtime (observed as a Fatal Python error in the app test
+    suite) — enqueue ordering alone is not a safety guarantee. What moves
+    off the training thread is the device->host scalar readback, which on
+    tunneled backends is the dominant cost (~0.1 s per conversion) and the
+    one ``block_until_ready`` does not cover there.
 
     ``after``: a previous thread from this function; the new thread waits
     for it before reporting, so successive reports stay in request order.
@@ -109,12 +176,24 @@ def compute_accuracy_async(state, eval_fn, test_batches, *, binary=False,
     correct, total = _accuracy_counts(
         state, eval_fn, test_batches, binary=binary
     )
+    # Drain the eval's reads of `state` before the caller donates it.
+    jax.block_until_ready(correct)
+    acc_now = None
+    if jax.default_backend() == "cpu":
+        # XLA:CPU intermittently aborts when a background host readback
+        # races the training thread's dispatches (seen as a Fatal Python
+        # error in the app suite). A local readback is ~free, so complete
+        # it inline on CPU and keep only the ordered reporting threaded;
+        # the overlap matters on tunneled device backends, where the
+        # readback is the ~0.1 s cost this function exists to move.
+        acc_now = int(correct) / max(total, 1)
 
     def _finalize():
         try:
             if after is not None:
                 after.join()
-            acc = int(correct) / max(total, 1)  # the one host readback
+            acc = (int(correct) / max(total, 1)  # the one host readback
+                   if acc_now is None else acc_now)
             if on_done is not None:
                 on_done(acc)
         except BaseException as exc:  # surfaced by the caller at join

@@ -42,7 +42,6 @@ import math
 import jax
 import jax.numpy as jnp
 import optax
-from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..attacks import apply_gradient_attack, apply_model_attack
@@ -168,7 +167,10 @@ def make_trainer(
 
         # Phase 1: per-node gradient on its own model + batch (unrolled over
         # the static local slots; vmapping params over nodes trips conv
-        # batching rules).
+        # batching rules). Keep the stacked TREE through the gather and
+        # flatten once afterwards — raveling each slot inside the unroll
+        # serializes the per-slot concats against fwd+bwd (measured 12%
+        # slower in aggregathor; core.per_slot_grads docstring).
         grads, losses, ms_list = [], [], []
         for k in range(per_n):
             p_k = jax.tree.map(lambda l: l[k], state.params)
@@ -176,10 +178,10 @@ def make_trainer(
             g, (loss, ms_out) = grad_fn(
                 p_k, state.model_state, x_local[k], y_local[k], rng_k
             )
-            grads.append(ravel_pytree(g)[0])
+            grads.append(g)
             losses.append(loss)
             ms_list.append(ms_out)
-        flat_local = jnp.stack(grads)  # (per_n, d)
+        grads_local = jax.tree.map(lambda *ls: jnp.stack(ls), *grads)
         losses = jnp.stack(losses)
         new_ms = core.mean_model_state(
             jax.tree.map(lambda *ls: jnp.stack(ls), *ms_list), axis
@@ -187,7 +189,10 @@ def make_trainer(
 
         # Phase 2: gather + attack + aggregate (= get_gradients(i, n-f) of
         # the fastest peers, LEARN/trainer.py:249; per-node subsets).
-        stack0 = jax.lax.all_gather(flat_local, axis, tiled=True)  # (n, d)
+        gathered = jax.tree.map(
+            lambda l: jax.lax.all_gather(l, axis, tiled=True), grads_local
+        )
+        stack0 = core.flatten_rows(gathered)  # (n, d)
         stack0 = apply_gradient_attack(
             attack, stack0, byz_mask, key=atk_key, **attack_params
         )

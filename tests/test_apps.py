@@ -83,6 +83,19 @@ def test_learn_non_iid():
     assert int(state.step) == 3
 
 
+def test_pima_ragged_test_set_evalset():
+    """pima's 168-sample test set batches into (100, 68) — EvalSet must
+    handle the ragged tail the app loop now always wraps (regression: the
+    first EvalSet stacked blindly and died at startup on pima)."""
+    state, summary = app_learn.main([
+        "--dataset", "pima", "--model", "pimanet", "--loss", "bce",
+        "--batch", "16", "--num_iter", "3", "--acc_freq", "2",
+        "--num_workers", "8", "--fw", "1", "--gar", "median",
+    ])
+    assert int(state.step) == 3
+    assert 0.0 <= summary["final_accuracy"] <= 1.0
+
+
 def test_garfield_cc_modes():
     for mode in ("vanilla", "aggregathor"):
         _, summary = app_garfield_cc.main(
