@@ -36,9 +36,14 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import aggregators
-from ..attacks import apply_gradient_attack, apply_model_attack, model_attacks
+from ..attacks import (
+    apply_gradient_attack,
+    apply_gradient_attack_tree,
+    apply_model_attack,
+    model_attacks,
+)
 from . import core, mesh as mesh_lib
-from .aggregathor import _check_gar, _resolve_gar
+from .aggregathor import _check_gar, _resolve_gar, _tree_path_ok
 
 __all__ = ["make_trainer"]
 
@@ -65,6 +70,7 @@ def make_trainer(
     subset=None,
     model_gar=None,
     granularity="model",
+    tree_path=True,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the MSMW topology.
 
@@ -77,6 +83,11 @@ def make_trainer(
     over model layers, Garfield_CC/trainer.py:55-204) — by segmenting the
     flat stacks at the (static) parameter boundaries; attacks still act on
     the whole flat vector.
+
+    ``tree_path`` (default on): rules with tree-mode aggregation (average,
+    krum) run the gradient phase on the stacked gradient TREE — no
+    (n_w, d) flat stack per PS slot (same win as aggregathor's tree path,
+    PERF.md); the model gather phase always works on flat model vectors.
 
     ``step_fn(state, x, y)``: ``x``/``y`` lead with ``num_workers`` sharded
     over ``axis``; state params/opt_state lead with ``num_ps`` sharded over
@@ -110,6 +121,10 @@ def make_trainer(
     init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
     repl = NamedSharding(mesh, P())
     ps_sharding = NamedSharding(mesh, P(ps_axis))
+    # True subsets force the flat path (dynamic per-leaf gathers measured
+    # 3.5x slower); without them tree == flat on one chip and tree avoids
+    # the per-PS flatten on real multi-chip meshes. See _tree_path_ok.
+    tree_ok = _tree_path_ok(tree_path, subset, num_workers, granularity, gar)
 
     def init_fn(key, example_x, seed_rng=None):
         params, model_state = init_worker(key, example_x)
@@ -175,6 +190,11 @@ def make_trainer(
             g, (loss, ms_out) = core.per_slot_grads(
                 grad_fn, params, ms, x_local, y_local, keys
             )
+            if tree_ok:
+                gathered = jax.tree.map(
+                    lambda l: jax.lax.all_gather(l, axis, tiled=True), g
+                )  # tree with (n_w, ...) leaves
+                return gathered, loss, ms_out
             flat = core.flatten_rows(g)  # (per_w, d)
             stack = jax.lax.all_gather(flat, axis, tiled=True)  # (n_w, d)
             return stack, loss, ms_out
@@ -191,21 +211,46 @@ def make_trainer(
             )
             for k in range(per_ps)
         ]
-        stacks = jnp.stack([o[0] for o in outs])  # (per_ps, n_w, d)
         losses = jnp.stack([o[1] for o in outs])  # (per_ps, per_w)
         ms_all = jax.tree.map(
             lambda *ls: jnp.stack(ls), *[o[2] for o in outs]
         )
 
-        stacks = jax.vmap(
-            lambda s: apply_gradient_attack(
-                attack, s, byz_worker_mask, key=atk_key, **attack_params
+        if tree_ok:
+            # Tree-mode gradient phase: per-PS attack + GAR + update, all
+            # on the stacked TREE (unrolled over the O(1) local PS slots;
+            # no flat stack is built). subset is None here (see tree_ok).
+            new_params_list, new_opt_list = [], []
+            for k in range(per_ps):
+                poisoned = apply_gradient_attack_tree(
+                    attack, outs[k][0], byz_worker_mask, key=atk_key,
+                    **attack_params,
+                )
+                aggr_tree = gar.tree_aggregate(
+                    poisoned, f=fw,
+                    key=jax.random.fold_in(gar_key, ps_ids[k]),
+                )
+                p_k = jax.tree.map(lambda l: l[k], state.params)
+                o_k = jax.tree.map(lambda l: l[k], state.opt_state)
+                updates, o_k = optimizer.update(aggr_tree, o_k, p_k)
+                new_params_list.append(optax.apply_updates(p_k, updates))
+                new_opt_list.append(o_k)
+            new_params = jax.tree.map(
+                lambda *ls: jnp.stack(ls), *new_params_list
             )
-        )(stacks)
+            new_opt = jax.tree.map(lambda *ls: jnp.stack(ls), *new_opt_list)
+        else:
+            stacks = jnp.stack([o[0] for o in outs])  # (per_ps, n_w, d)
+            stacks = jax.vmap(
+                lambda s: apply_gradient_attack(
+                    attack, s, byz_worker_mask, key=atk_key, **attack_params
+                )
+            )(stacks)
 
-        new_params, new_opt = jax.vmap(
-            _ps_slot_step, in_axes=(0, 0, 0, 0, None)
-        )(ps_ids, state.params, state.opt_state, stacks, (sub_key, gar_key))
+            new_params, new_opt = jax.vmap(
+                _ps_slot_step, in_axes=(0, 0, 0, 0, None)
+            )(ps_ids, state.params, state.opt_state, stacks,
+              (sub_key, gar_key))
 
         # --- model gather phase (ByzSGD/trainer.py:240-244) ----------------
         flat_models = core.flatten_rows(new_params)  # (per_ps, d)

@@ -58,7 +58,15 @@ class TestAggregathor:
 
     @pytest.mark.parametrize("gar,attack,f,subset", [
         ("krum", "lie", 2, None),
+        ("krum", "reverse", 2, None),
+        # subset=7 is a TRIPWIRE: today the gate sends BOTH flag values down
+        # the flat path (trivially equal); if tree-mode subset selection is
+        # ever re-enabled, this row becomes a real tree-vs-flat equivalence
+        # check on the per-subset key derivation.
         ("krum", "reverse", 2, 7),
+        # subset == n never selects rows and stays tree-eligible: this row
+        # genuinely compares tree vs flat.
+        ("krum", "reverse", 2, 8),
         ("average", "empire", 2, None),
         ("average", None, 0, None),
     ])
@@ -223,6 +231,30 @@ class TestByzSGD:
         for leaf in jax.tree.leaves(params):
             for i in range(1, leaf.shape[0]):
                 np.testing.assert_allclose(leaf[i], leaf[0], rtol=1e-6)
+
+    def test_tree_path_matches_flat_path_byzsgd(self):
+        """ByzSGD's tree-mode gradient phase (krum) must reproduce the flat
+        path's trajectory. (subset runs always take the flat path — the
+        tree gate — so the A/B uses full participation, where the paths
+        genuinely differ.)"""
+        module, loss, opt = _pima_setup()
+        x, y = _pima_batches(8, 16)
+        mesh = make_mesh({"ps": 2, "workers": 4})
+        runs = []
+        for tree_path in (True, False):
+            init_fn, step_fn, _ = byzsgd.make_trainer(
+                module, loss, opt, "krum", num_workers=8, num_ps=4, fw=2,
+                fps=1, attack="lie", ps_attack="reverse", mesh=mesh,
+                model_gar="median", tree_path=tree_path,
+            )
+            state = init_fn(jax.random.PRNGKey(0), x[0])
+            state, losses = _run(step_fn, state, x, y, 5)
+            runs.append((losses, jax.device_get(state.params)))
+        np.testing.assert_allclose(runs[0][0], runs[1][0], rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+            runs[0][1], runs[1][1],
+        )
 
     def test_per_ps_subset_divergence_then_agreement(self):
         module, loss, opt = _pima_setup()
