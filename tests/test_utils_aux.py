@@ -83,6 +83,52 @@ def test_checkpointer_pickle_fallback(tmp_path, monkeypatch):
     np.testing.assert_array_equal(out["w"], state["w"])
 
 
+def test_evalset_matches_list_path():
+    """parallel.EvalSet (one scanned program) must count exactly like the
+    per-batch list path — uniform batches, a ragged tail, and the binary
+    threshold path."""
+    import jax
+    import jax.numpy as jnp
+
+    from garfield_tpu import parallel
+
+    rng = np.random.default_rng(0)
+
+    def eval_fn(state, x):
+        return jnp.asarray(x) @ state  # logits = x @ W
+
+    # Multiclass with a ragged tail batch (like pima's 100+68 test split).
+    state = jnp.asarray(rng.standard_normal((5, 3)), jnp.float32)
+    batches = [
+        (rng.standard_normal((4, 5)).astype(np.float32),
+         rng.integers(0, 3, 4))
+        for _ in range(3)
+    ] + [(rng.standard_normal((2, 5)).astype(np.float32),
+          rng.integers(0, 3, 2))]
+    want = parallel.compute_accuracy(state, eval_fn, batches)
+    got = parallel.compute_accuracy(
+        state, eval_fn, parallel.EvalSet(batches)
+    )
+    assert got == want
+
+    # Binary path: single sigmoid-like output, labels (n, 1) float.
+    bstate = jnp.asarray(rng.standard_normal((5, 1)), jnp.float32)
+
+    def beval(state, x):
+        return jax.nn.sigmoid(jnp.asarray(x) @ state)
+
+    bbatches = [
+        (rng.standard_normal((4, 5)).astype(np.float32),
+         rng.integers(0, 2, (4, 1)).astype(np.float32))
+        for _ in range(2)
+    ]
+    want_b = parallel.compute_accuracy(bstate, beval, bbatches, binary=True)
+    got_b = parallel.compute_accuracy(
+        bstate, beval, parallel.EvalSet(bbatches, binary=True)
+    )
+    assert got_b == want_b
+
+
 def test_gar_bench_smoke():
     from garfield_tpu.apps.benchmarks import gar_bench
 
