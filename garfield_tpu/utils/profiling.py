@@ -24,7 +24,55 @@ __all__ = [
     "trace",
     "collective_bytes",
     "convert_to_gbit",
+    "enable_compile_cache",
+    "is_transient_backend_error",
 ]
+
+
+def enable_compile_cache(cache_dir=None):
+    """Enable the persistent XLA compile cache (best-effort, never raises).
+
+    Shared by ``bench.py`` and ``__graft_entry__.py``: the north-star step and
+    the dryrun topologies are large SPMD programs (~30 s first compile on the
+    tunneled chip); caching makes retries after transient tunnel failures and
+    driver re-runs near-instant. Safe to call before any backend use.
+    """
+    import os
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            cache_dir
+            or os.path.expanduser("~/.cache/garfield_tpu/jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # cache is an optimization; never fail the caller
+
+
+# Substrings that mark a *transient* backend/tunnel failure worth retrying.
+# Deterministic failures (lowering errors, shape errors, OOM) must surface
+# immediately — see BENCH_r02.json for the motivating mid-compile drop.
+_TRANSIENT_ERROR_MARKS = (
+    "read body",
+    "response body closed",
+    "remote_compile",
+    "connection",
+    "unavailable",
+    "deadline exceeded",
+    "socket",
+    "timed out",
+    "timeout",
+    "broken pipe",
+    "reset by peer",
+)
+
+
+def is_transient_backend_error(exc):
+    """True when ``exc`` looks like a transient tunnel/transport failure."""
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return any(mark in msg for mark in _TRANSIENT_ERROR_MARKS)
 
 
 def paired_reps(timed_fn, reps, floor=1e-9, pairs=3):
