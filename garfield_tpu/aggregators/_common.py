@@ -64,9 +64,18 @@ def pairwise_distances(g, *, exclude_self=True):
     """(n, n) Euclidean distance matrix via the Gram trick.
 
     The inner product rides the MXU instead of materializing (n, n, d)
-    differences (see ``distances_from_gram``).
+    differences (see ``distances_from_gram``). The Gram is ACCUMULATED in
+    at-least-float32 like ``tree_gram`` — under bf16 gradients the flat and
+    tree paths must make the SAME selections — via
+    ``preferred_element_type``, so the (n, d) operands stay in their input
+    dtype (no f32 copy of the stack; bf16 in / f32 out is the MXU's native
+    mode).
     """
-    return distances_from_gram(g @ g.T, exclude_self=exclude_self)
+    acc = jnp.promote_types(g.dtype, jnp.float32)
+    return distances_from_gram(
+        jnp.matmul(g, g.T, preferred_element_type=acc),
+        exclude_self=exclude_self,
+    )
 
 
 def tree_gram(grads_tree):
@@ -75,14 +84,19 @@ def tree_gram(grads_tree):
     <g_i, g_j> over the flat concatenation equals the sum of per-leaf inner
     products, so the Gram of the virtual (n, d) stack is computed without
     ever materializing it — each leaf contributes one (n, size) MXU matmul.
-    Accumulated in float32 regardless of leaf dtype.
+    Accumulated in at-least-float32 regardless of leaf dtype (matching
+    ``pairwise_distances`` so flat and tree selections agree under bf16),
+    with the leaf operands kept in their input dtype.
     """
     leaves = jax.tree.leaves(grads_tree)
     n = leaves[0].shape[0]
-    total = jnp.zeros((n, n), jnp.float32)
+    acc_dtype = jnp.promote_types(leaves[0].dtype, jnp.float32)
+    total = jnp.zeros((n, n), acc_dtype)
     for leaf in leaves:
-        x = leaf.reshape(n, -1).astype(jnp.float32)
-        total = total + x @ x.T
+        x = leaf.reshape(n, -1)
+        total = total + jnp.matmul(
+            x, x.T, preferred_element_type=acc_dtype
+        )
     return total
 
 

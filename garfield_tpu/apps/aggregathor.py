@@ -23,7 +23,29 @@ def main(argv=None):
     parser = common.base_parser(
         "AggregaThor implementation using garfield-tpu"
     )
+    parser.add_argument(
+        "--cluster", type=str, default=None,
+        help="Cluster config JSON (utils/multihost.ClusterConfig): run as "
+             "ONE process of a multi-process deployment over PeerExchange "
+             "(true wait-n-f; the reference's run_exp.sh fan-out shape) "
+             "instead of the on-mesh SPMD fold.",
+    )
+    parser.add_argument(
+        "--task", type=str, default=None,
+        help='Role override for --cluster, "ps:0" or "worker:K" (default: '
+             "the config's own task section).",
+    )
+    parser.add_argument(
+        "--cluster_timeout_ms", type=int, default=60_000,
+        help="Per-step collect timeout in cluster mode (the bounded-retry "
+             "exit of the reference, ps.py:84-88).",
+    )
     args = parser.parse_args(argv)
+    if args.cluster:
+        from . import cluster
+
+        args.num_workers = None  # worker count comes from the config
+        return cluster.run(args)
     assert args.fw * 2 < args.num_workers, (
         "the number of Byzantine workers should be less than half the number "
         "of workers"  # Aggregathor/trainer.py:150-152 invariant

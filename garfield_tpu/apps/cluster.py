@@ -1,0 +1,312 @@
+"""Cross-process AggregaThor: one OS process per node, PeerExchange DCN.
+
+This is the host-driver deployment shape of the reference — one process per
+node pulling models/gradients through the message exchange
+(tensorflow_impl/applications/AggregaThor/trainer.py:55-95, fanned out by
+run_exp.sh) — with the gRPC servicer replaced by ``utils.exchange.
+PeerExchange`` (TCP frames + the native MRMW register). Unlike the on-mesh
+SPMD topologies (parallel/aggregathor.py), synchronization here is REAL
+wait-n-f: the PS proceeds with the q = n_w - f *fastest* worker gradients
+per step (server.py:134-155), so crashed or straggling workers are simply
+absent from the quorum — no seeded-subset emulation.
+
+Roles (ClusterConfig task):
+  - ``ps`` (rank 0, exactly one — the AggregaThor SSMW trusted server):
+    publishes the flat model each step, collects the q fastest worker
+    gradients, aggregates with the GAR, applies the optimizer update.
+  - ``worker`` (ranks 1..n_w): collects the step's model from the PS slot,
+    computes its data shard's gradient, publishes the flat gradient back to
+    the PS. A worker started with ``--attack`` is a REAL Byzantine process
+    (byzWorker.py:50-125): it poisons its own published gradient
+    host-side; it cannot see honest gradients, so only the self-contained
+    attacks (reverse, random, crash) apply — the statistics-aware ones
+    (lie, empire) remain the on-mesh topologies' domain.
+
+Both planes share one exchange: the PS slot only ever carries models, the
+worker slots only gradients, and ``collect(..., peers=...)`` waits on
+exactly the relevant slots.
+
+Model-state (BatchNorm) caveat: only gradients/params travel, so worker BN
+statistics evolve locally — the same silent semantics as the reference,
+whose RPC path also ships gradients only (see parallel/core.py docstring).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.flatten_util import ravel_pytree
+
+from ..aggregators import gars
+from ..parallel import core
+from ..utils import multihost, tools
+from ..utils.exchange import PeerExchange
+from . import common
+
+__all__ = ["run"]
+
+
+def _host_attack(name, params):
+    """Self-contained Byzantine gradient attacks, applied by the attacker
+    process to its OWN gradient (byzWorker.py: 'random' :60-66, 'reverse'
+    :68-77; 'crash' = the process simply dies, covered by killing it)."""
+    if name is None:
+        return None
+    scale = float(params.get("scale", 100.0))
+    rng = np.random.default_rng(int(params.get("seed", 666)))
+    if name == "random":
+        return lambda g: rng.standard_normal(g.shape).astype(g.dtype) * scale
+    if name == "reverse":
+        return lambda g: g * (-scale)
+    raise SystemExit(
+        f"--attack {name!r} needs the honest gradients' statistics and only "
+        "exists on the on-mesh topologies; cluster workers support "
+        "random/reverse (or kill the process for a crash)."
+    )
+
+
+def _setup(args):
+    """Shared ingredients for both roles."""
+    cfg = multihost.ClusterConfig(args.cluster)
+    if args.task:
+        ttype, _, tidx = args.task.partition(":")
+        cfg.task_type = ttype
+        cfg.task_index = int(tidx or 0)
+    if len(cfg.ps) != 1:
+        raise SystemExit(
+            "cluster mode is the AggregaThor SSMW topology: exactly one "
+            f"trusted PS (got {len(cfg.ps)}); multi-PS ByzSGD runs on-mesh."
+        )
+    n_w = len(cfg.workers)
+    f = args.fw
+    q = n_w - f
+    if not f * 2 < n_w:
+        # The majority-honest invariant the reference asserts
+        # (Aggregathor/trainer.py:150-152) — enforced against the CONFIG's
+        # worker count (the --cluster path bypasses the on-mesh assert).
+        raise SystemExit(
+            f"the number of Byzantine workers should be less than half the "
+            f"number of workers (fw={f}, config has {n_w} workers)"
+        )
+    # Fail fast with the GAR's own contract before any process waits on
+    # another (e.g. krum needs q >= 2f+3).
+    if f:
+        msg = gars[args.gar].check(np.zeros((q, 4), np.float32), f=f)
+        if msg is not None:
+            raise SystemExit(
+                f"GAR {args.gar!r} cannot run on the q = n_w - fw = {q} "
+                f"collected gradients: {msg}"
+            )
+    xs, ys, test_batches, iters_per_epoch = common.load_data(args, n_w)
+    module, loss_fn, optimizer = common.build_ingredients(
+        args, iters_per_epoch
+    )
+    init_fn, grad_fn, eval_fn = core.make_worker_fns(module, loss_fn)
+    params0, ms0 = init_fn(jax.random.PRNGKey(args.seed), xs[0, 0])
+    # Role-aware retention: the PS never trains (drop the shards), a worker
+    # only reads its own shard (drop the rest and the test set) — no point
+    # keeping n_w + 1 copies of the dataset across the deployment's hosts.
+    if cfg.task_type == "ps":
+        xs = ys = None
+    else:
+        xs, ys = xs[cfg.task_index], ys[cfg.task_index]
+        test_batches = None
+    flat0, unravel = ravel_pytree(params0)
+    ex = PeerExchange(cfg.process_id, cfg.hosts)
+    return (cfg, n_w, f, q, xs, ys, test_batches, optimizer, grad_fn,
+            eval_fn, params0, ms0, flat0, unravel, ex)
+
+
+def run(args):
+    """Entry: dispatch on the configured role."""
+    (cfg, n_w, f, q, xs, ys, test_batches, optimizer, grad_fn, eval_fn,
+     params0, ms0, flat0, unravel, ex) = _setup(args)
+    worker_ranks = list(range(1, 1 + n_w))
+    timeout_ms = args.cluster_timeout_ms
+    try:
+        if cfg.task_type == "ps":
+            return _run_ps(
+                args, q, worker_ranks, test_batches, optimizer, eval_fn,
+                params0, ms0, flat0, unravel, ex, timeout_ms,
+            )
+        return _run_worker(
+            args, cfg.task_index, xs, ys, grad_fn, ms0, flat0, unravel, ex,
+            timeout_ms,
+        )
+    finally:
+        ex.close()
+
+
+def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
+            params0, ms0, flat0, unravel, ex, timeout_ms):
+    """The trusted server: model out, q fastest gradients in, GAR, update."""
+    from .. import parallel
+
+    f = args.fw
+    gar = gars[args.gar]
+    opt_state0 = optimizer.init(params0)
+    test_batches = parallel.EvalSet(
+        test_batches, binary=args.dataset == "pima"
+    )
+
+    @jax.jit
+    def ps_update(flat_params, opt_state, grads_stack):
+        agg = gar.unchecked(grads_stack, f=f) if f else jnp.mean(
+            grads_stack, axis=0
+        )
+        params = unravel(flat_params)
+        updates, opt_state = optimizer.update(
+            unravel(agg), opt_state, params
+        )
+        params = optax.apply_updates(params, updates)
+        return ravel_pytree(params)[0], opt_state
+
+    def acc_eval(state_flat):
+        return parallel.compute_accuracy(
+            (unravel(state_flat), ms0),
+            lambda s, x: eval_fn(s[0], s[1], x),
+            test_batches,
+            binary=args.dataset == "pima",
+        )
+
+    t0 = time.time()
+    flat = np.asarray(flat0, np.float32)
+    flat_dev, opt_state = jnp.asarray(flat), opt_state0
+    d_bytes = flat.size * 4
+    good_ranks = list(worker_ranks)
+    losses_seen = 0
+    for i in range(args.num_iter):
+        ex.publish(i, flat.tobytes(), to=worker_ranks)
+        # A Byzantine PROCESS controls its wire bytes, not just its values:
+        # a wrong-length payload cannot enter the GAR (frombuffer/stack
+        # would throw) and proves its sender Byzantine — exclude the rank
+        # from all future quorums and re-collect from the rest (the frames
+        # already received return instantly). A quorum TIMEOUT triggers a
+        # model re-publish before the final attempt: the model plane is
+        # fire-and-forget, so workers whose listener bound after this
+        # step's publish (cold start) would otherwise never see a frame to
+        # catch up to and the healthy cluster would deadlock.
+        attempts = 0
+        while True:
+            try:
+                got = ex.collect(
+                    i, q, peers=good_ranks, timeout_ms=timeout_ms
+                )
+            except TimeoutError:
+                attempts += 1
+                if attempts >= 3:
+                    raise
+                tools.warning(
+                    f"[cluster-ps] step {i} quorum timed out; re-publishing "
+                    f"the model (attempt {attempts})"
+                )
+                ex.publish(i, flat.tobytes(), to=worker_ranks)
+                continue
+            bad = [k for k in got if len(got[k]) != d_bytes]
+            if not bad:
+                break
+            for k in bad:
+                tools.warning(
+                    f"[cluster-ps] worker rank {k} sent a malformed "
+                    f"{len(got[k])}-byte gradient (expected {d_bytes}); "
+                    "excluding it from all future quorums"
+                )
+            good_ranks = [k for k in good_ranks if k not in bad]
+            if len(good_ranks) < q:
+                raise SystemExit(
+                    f"only {len(good_ranks)} well-formed workers remain "
+                    f"but the quorum needs q={q}; aborting"
+                )
+        # Deterministic composition: of the >= q arrivals, aggregate the q
+        # lowest ranks (the GAR's n is static under jit).
+        rows = [
+            np.frombuffer(got[k], np.float32) for k in sorted(got)[:q]
+        ]
+        flat_dev, opt_state = ps_update(
+            flat_dev, opt_state, jnp.asarray(np.stack(rows))
+        )
+        flat = np.asarray(flat_dev, np.float32)  # next step's publication
+        losses_seen = i + 1
+        if args.acc_freq and i % args.acc_freq == 0:
+            acc = acc_eval(flat_dev)
+            print(
+                f"Step: {i} Accuracy: {acc:.4f} "
+                f"Time: {time.time() - t0:.1f}",
+                flush=True,
+            )
+    # Stop sentinel: an empty frame at step num_iter tells every worker
+    # (including stragglers that skipped rounds) training is over.
+    ex.publish(args.num_iter, b"", to=worker_ranks)
+    acc = acc_eval(flat_dev)
+    summary = {
+        "final_accuracy": acc,
+        "steps": losses_seen,
+        "wall_s": time.time() - t0,
+    }
+    print(json.dumps({"tag": "cluster-ps", **summary}), flush=True)
+    return summary
+
+
+def _run_worker(args, windex, my_xs, my_ys, grad_fn, ms0, flat0, unravel,
+                ex, timeout_ms):
+    """One worker process: model in, shard gradient out. ``windex`` is the
+    worker's data shard; its exchange rank is 1 + windex.
+
+    The model read is ``read_latest`` (newest round >= the expected one),
+    NOT an exact-step collect: a straggler whose expected model was already
+    overwritten in the last-writer-wins slot must catch up to the PS's
+    current round, not crash — turning a tolerated straggler into a
+    permanent casualty would silently consume the f budget.
+    """
+    attack = _host_attack(args.attack, args.attack_params)
+
+    @jax.jit
+    def worker_grad(flat_params, ms, x, y, rng):
+        grads, (loss, new_ms) = grad_fn(unravel(flat_params), ms, x, y, rng)
+        return ravel_pytree(grads)[0], loss, new_ms
+
+    base_key = jax.random.PRNGKey(args.seed + 1 + windex)
+    d_bytes = int(np.asarray(flat0).size) * 4
+    num_batches = my_xs.shape[0]
+    ms = ms0
+    loss = None
+    steps_done = 0
+    i = 0
+    while i < args.num_iter:
+        step, payload = ex.read_latest(0, i, timeout_ms=timeout_ms)
+        if step >= args.num_iter or not payload:
+            break  # PS's stop sentinel (empty frame at num_iter)
+        if len(payload) != d_bytes:
+            # NOT the sentinel: a non-empty model frame of the wrong size
+            # means the PS runs a different model/dtype config — a
+            # deployment error that must fail loudly, not exit rc 0.
+            raise SystemExit(
+                f"model frame is {len(payload)} bytes but this worker's "
+                f"model flattens to {d_bytes}; PS and worker configs "
+                "disagree (--model/--dtype/--dataset)"
+            )
+        b = step % num_batches
+        g, loss, ms = worker_grad(
+            jnp.asarray(np.frombuffer(payload, np.float32)), ms,
+            my_xs[b], my_ys[b], jax.random.fold_in(base_key, step),
+        )
+        g = np.asarray(g, np.float32)
+        if attack is not None:
+            g = attack(g)
+        ex.publish(step, g.tobytes(), to=[0])
+        steps_done += 1
+        if args.log:
+            print(
+                f"Worker {windex} loss {step}: {float(loss):.6f}", flush=True
+            )
+        i = step + 1
+    summary = {
+        "steps": steps_done,
+        "final_loss": float(loss) if loss is not None else None,
+    }
+    print(json.dumps({"tag": f"cluster-worker-{windex}", **summary}),
+          flush=True)
+    return summary

@@ -67,7 +67,12 @@ class EvalSet:
             (jnp.asarray(x), jnp.asarray(np.asarray(y).reshape(-1)))
             for x, y in test_batches
         ]
-        shape0 = batches[0][0].shape if batches else None
+        if not batches:
+            raise ValueError(
+                "EvalSet needs at least one test batch (got an empty "
+                "test_batches); check the dataset/test split configuration"
+            )
+        shape0 = batches[0][0].shape
         uniform = [b for b in batches if b[0].shape == shape0]
         self.ragged = [b for b in batches if b[0].shape != shape0]
         self.xs = jnp.stack([x for x, _ in uniform])

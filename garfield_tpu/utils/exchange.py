@@ -69,13 +69,17 @@ class PeerExchange:
     """
 
     def __init__(self, my_index, hosts, *, accept_timeout_ms=100,
-                 connect_retry_ms=10_000):
+                 connect_retry_ms=10_000, reconnect_timeout_ms=1_000,
+                 send_timeout_ms=5_000):
         self.my_index = int(my_index)
         self.hosts = list(hosts)
         self.n = len(self.hosts)
         self.connect_retry_ms = connect_retry_ms
+        self.reconnect_timeout_ms = reconnect_timeout_ms
+        self.send_timeout_ms = send_timeout_ms
         self._mb = MultiBuffer(self.n)
         self._send_socks = {}
+        self._connect_attempted = set()  # peers whose startup grace is spent
         self._send_lock = threading.Lock()
         self._closing = threading.Event()
         self._waiters = []       # collect()'s reader threads, joined at close
@@ -133,39 +137,67 @@ class PeerExchange:
     # --- send side ---------------------------------------------------------
 
     def _sock_for(self, idx):
-        """Cached connection to peer idx; retries the FIRST connect for up
-        to ``connect_retry_ms`` — peers come up in arbitrary order and a
-        publish must not lose its frame to a listener that is still
-        binding (the reference's pull loops retry the same way,
-        server.py:138-141)."""
+        """Cached connection to peer idx.
+
+        Only the FIRST-ever connect to a peer gets the long
+        ``connect_retry_ms`` grace — peers come up in arbitrary order and a
+        publish must not lose its frame to a listener that is still binding
+        (the reference's pull loops retry the same way, server.py:138-141).
+        RE-connects (the cached socket died, i.e. the peer crashed or
+        restarted) make one short ``reconnect_timeout_ms`` attempt instead:
+        a crashed receiver must not stall every subsequent step's publish
+        for the full grace window while ``_send_lock`` is held. The default
+        (1 s) leaves room for WAN connect RTTs; an UNREACHABLE (not merely
+        refused — refusal is instant) peer costs each publish at most that
+        much until it returns.
+
+        Once connected, the socket's timeout is reset to ``send_timeout_ms``
+        — the connect timeout must NOT govern ``sendall`` (a multi-MB model
+        frame cannot ship in the 100 ms reconnect window), while a hung
+        (not crashed) receiver still cannot block publish forever.
+        """
         sock = self._send_socks.get(idx)
         if sock is not None:
             return sock
         ip, _, port = self.hosts[idx].rpartition(":")
-        deadline = time.monotonic() + self.connect_retry_ms / 1000.0
-        while True:
-            try:
-                sock = socket.create_connection((ip, int(port)), timeout=5)
-                break
-            except OSError:
-                if time.monotonic() >= deadline or self._closing.is_set():
-                    raise
-                time.sleep(0.05)
+        if idx in self._connect_attempted:
+            sock = socket.create_connection(
+                (ip, int(port)), timeout=self.reconnect_timeout_ms / 1000.0
+            )
+        else:
+            self._connect_attempted.add(idx)
+            deadline = time.monotonic() + self.connect_retry_ms / 1000.0
+            while True:
+                try:
+                    sock = socket.create_connection(
+                        (ip, int(port)), timeout=5
+                    )
+                    break
+                except OSError:
+                    if (time.monotonic() >= deadline
+                            or self._closing.is_set()):
+                        raise
+                    time.sleep(0.05)
+        sock.settimeout(self.send_timeout_ms / 1000.0)
         self._send_socks[idx] = sock
         return sock
 
-    def publish(self, step, payload):
-        """Send (step, payload) to every peer; deposit locally too.
+    def publish(self, step, payload, *, to=None):
+        """Send (step, payload) to every peer (or just ``to``); deposit
+        locally too.
 
         Unreachable peers are skipped silently: a publisher must not block
         on a crashed receiver (the reference's async sends are fire-and-
-        forget RPCs, server.py:127).
+        forget RPCs, server.py:127). ``to`` narrows the fan-out — e.g.
+        workers in the cluster driver send gradients only to the PS, like
+        the reference's point-to-point RPC pulls.
         """
         payload = bytes(payload)
         self._mb.write(self.my_index, _SLOT.pack(step) + payload)
         frame = _HDR.pack(self.my_index, step, len(payload)) + payload
+        targets = range(self.n) if to is None else to
         with self._send_lock:
-            for idx in range(self.n):
+            for idx in targets:
                 if idx == self.my_index:
                     continue
                 try:
@@ -208,19 +240,28 @@ class PeerExchange:
         finally:
             sem.release()
 
-    def collect(self, step, q, *, timeout_ms=30_000):
+    def collect(self, step, q, *, timeout_ms=30_000, peers=None):
         """Payloads of the q fastest peers (self included) at ``step``.
 
         Returns a dict {peer_index: payload} with >= q entries, or raises
         TimeoutError if fewer than q peers published within ``timeout_ms``
         — the bounded-retry exit of the reference (ps.py:84-88 gives up
-        after 10 retries and exits).
+        after 10 retries and exits). ``peers`` restricts the wait to a
+        subset of slots — e.g. the PS waits on worker slots only (gradient
+        plane) while workers wait on the PS slot only (model plane), so
+        both planes share one exchange without cross-talk.
         """
         if step >= _CLOSE_STEP:
             raise ValueError(f"step {step} reserved for the close sentinel")
+        peers = list(range(self.n)) if peers is None else list(peers)
+        if q > len(peers):
+            raise ValueError(f"q={q} exceeds the {len(peers)} waited peers")
         results = {}
         sem = threading.Semaphore(0)
-        for idx in range(self.n):
+        # Prune finished waiters from earlier collects — without this a long
+        # run retains O(steps * n) dead Thread objects until close().
+        self._waiters = [t for t in self._waiters if t.is_alive()]
+        for idx in peers:
             t = threading.Thread(
                 target=self._wait_slot,
                 args=(idx, step, timeout_ms, results, sem),
@@ -229,15 +270,48 @@ class PeerExchange:
             self._waiters.append(t)
             t.start()
         # Every waiter releases exactly once (success or timeout); keep
-        # draining until the quorum is met or all n waiters are accounted
+        # draining until the quorum is met or all waited slots are accounted
         # for — a timed-out straggler must not mask a still-pending success.
-        for _ in range(self.n):
+        for _ in range(len(peers)):
             sem.acquire()
             if len(results) >= q:
                 return dict(results)
         raise TimeoutError(
             f"only {len(results)}/{q} peers reached step {step} "
             f"within {timeout_ms} ms"
+        )
+
+    def read_latest(self, idx, min_step, *, timeout_ms=30_000):
+        """Newest (step, payload) in peer ``idx``'s slot with step >=
+        ``min_step``.
+
+        The catch-up read for consumers of a FAST producer: ``collect``'s
+        exact-step contract is right for same-round quorums (gradients), but
+        a straggler reading the PS's model slot must accept the newest
+        round, not die because the one it expected was overwritten (the
+        last-writer-wins register keeps only the latest frame). Returns as
+        soon as the current or a newly-written frame satisfies the bound;
+        raises TimeoutError otherwise.
+        """
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        version = 0
+        while not self._closing.is_set():
+            remaining_ms = int((deadline - time.monotonic()) * 1000)
+            if remaining_ms <= 0:
+                break
+            try:
+                version, raw = self._mb.read(
+                    idx, min_version=version + 1, timeout_ms=remaining_ms
+                )
+            except TimeoutError:
+                break
+            (got_step,) = _SLOT.unpack_from(raw)
+            if got_step == _CLOSE_STEP:
+                break
+            if got_step >= min_step:
+                return got_step, raw[_SLOT.size:]
+        raise TimeoutError(
+            f"peer {idx} did not reach step {min_step} within {timeout_ms} ms"
         )
 
     def close(self):

@@ -92,6 +92,75 @@ def test_overwritten_step_is_not_mixed_in():
             p.close()
 
 
+def test_publish_does_not_stall_on_crashed_peer():
+    """ADVICE r2 (medium): once a peer has crashed, every subsequent
+    publish must not burn the full first-connect grace window
+    (connect_retry_ms, default 10 s) re-dialing it — reconnects get one
+    short attempt and the frame is dropped (fire-and-forget contract)."""
+    import time
+
+    peers = _mesh(2)
+    try:
+        for p in peers:
+            p.publish(0, b"warm")  # establishes both send sockets
+        for p in peers:
+            assert len(p.collect(0, q=2, timeout_ms=10_000)) == 2
+        peers[1].close()  # peer 1 crashes
+        # Publishes from peer 0 keep flowing; each must return fast even
+        # though peer 1's endpoint now refuses/ignores connections.
+        t0 = time.monotonic()
+        for step in range(1, 4):
+            peers[0].publish(step, b"alone")
+        elapsed = time.monotonic() - t0
+        assert elapsed < peers[0].connect_retry_ms / 1000.0, (
+            f"publish stalled {elapsed:.1f}s on a crashed peer"
+        )
+        # Own slot still collects: the survivor makes progress at q=1.
+        got = peers[0].collect(3, q=1, timeout_ms=5_000)
+        assert got == {0: b"alone"}
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_read_latest_catches_up_past_overwrites():
+    """read_latest: a slow consumer of a fast producer's last-writer-wins
+    slot accepts the NEWEST frame >= its expected step instead of dying on
+    the overwritten exact step (the cluster worker's model-plane read)."""
+    import threading
+    import time
+
+    peers = _mesh(2)
+    try:
+        # Producer races ahead: steps 0..3 land, only 3 survives.
+        for s in range(4):
+            peers[1].publish(s, f"m{s}".encode())
+        deadline = time.time() + 10
+        while peers[0]._mb.version(1) < 4 and time.time() < deadline:
+            time.sleep(0.02)
+        step, payload = peers[0].read_latest(1, 1, timeout_ms=5_000)
+        assert (step, payload) == (3, b"m3")
+        # Expecting a FUTURE step blocks until it is published.
+        result = {}
+
+        def waiter():
+            result["got"] = peers[0].read_latest(1, 7, timeout_ms=15_000)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        peers[1].publish(7, b"m7")
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert result["got"] == (7, b"m7")
+        # And a producer that never advances times out.
+        with pytest.raises(TimeoutError):
+            peers[0].read_latest(1, 99, timeout_ms=200)
+    finally:
+        for p in peers:
+            p.close()
+
+
 def test_late_joiner_catches_up():
     """A collect blocked on a not-yet-published step wakes when the frame
     arrives — the blocking-read path of the register, no polling."""

@@ -324,3 +324,29 @@ def test_tmean_nan_trimmed():
     g[0] = np.nan  # sorts last per coordinate -> inside the trimmed tail
     out = np.asarray(gars["tmean"](g, f=1))
     np.testing.assert_allclose(out, np.ones(4))
+
+
+def test_bf16_gram_flat_tree_agree():
+    """ADVICE r2: both Gram paths accumulate in at-least-f32 (bf16 inputs
+    no longer make the flat path compute a bf16 Gram), so under bf16
+    gradients flat and tree Krum score the same candidates to within f32
+    leaf-sum rounding and pick the SAME rows."""
+    from garfield_tpu.aggregators import _common
+
+    g16 = jnp.asarray(stack(8, 96)).astype(jnp.bfloat16)
+    # Tree with two leaves whose flattened concat is the flat stack.
+    tree = {"a": g16[:, :40].reshape(8, 5, 8), "b": g16[:, 40:]}
+    gram_tree = _common.tree_gram(tree)
+    d_flat = _common.pairwise_distances(g16)
+    d_tree = _common.distances_from_gram(gram_tree)
+    assert gram_tree.dtype == jnp.float32
+    assert d_flat.dtype == jnp.float32
+    # Per-leaf partial sums reorder the f32 accumulation, so the paths agree
+    # to rounding, not bitwise — selections must still coincide.
+    np.testing.assert_allclose(
+        np.asarray(d_flat), np.asarray(d_tree), rtol=1e-5, atol=1e-5
+    )
+    k = 8 - 2 - 2  # n - f - 2 nearest neighbours, f=2
+    sc_flat = np.sort(np.asarray(d_flat), axis=1)[:, :k].sum(axis=1)
+    sc_tree = np.sort(np.asarray(d_tree), axis=1)[:, :k].sum(axis=1)
+    assert np.argmin(sc_flat) == np.argmin(sc_tree)

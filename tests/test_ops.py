@@ -6,6 +6,7 @@ bulyan.py:77-84); the kernels must match them bit-for-bit, including NaN
 placement and stable tie-breaking.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -87,6 +88,31 @@ def test_dispatch_falls_back_off_tpu():
     np.testing.assert_array_equal(
         np.asarray(coordinate.coordinate_median(x)),
         np.asarray(coordinate.coordinate_median_reference(jnp.asarray(x))),
+    )
+
+
+def test_cpu_lowering_on_tpu_default_process(monkeypatch):
+    """ADVICE r1 / VERDICT r2 #7 regression: a computation jitted for CPU
+    devices in a process whose DEFAULT backend is TPU must take the XLA
+    fallback, not fail lowering the Pallas kernel. The per-call choice is
+    made by ``lax.platform_dependent`` at lowering time; simulate the
+    TPU-default process by patching ``jax.default_backend`` so the
+    ``use_pallas`` gate opens, then lower+run on this CPU backend."""
+    monkeypatch.setattr(coordinate.jax, "default_backend", lambda: "tpu")
+    assert coordinate.use_pallas()  # gate open: dispatch reaches the router
+    x = _rand(6, 50, seed=13)
+    got = jax.jit(coordinate.coordinate_median)(x)
+    np.testing.assert_array_equal(
+        np.asarray(got),
+        np.asarray(coordinate.coordinate_median_reference(jnp.asarray(x))),
+    )
+    got = jax.jit(lambda a: coordinate.averaged_median_mean(a, 3))(x)
+    np.testing.assert_allclose(
+        np.asarray(got),
+        np.asarray(
+            coordinate.averaged_median_mean_reference(jnp.asarray(x), 3)
+        ),
+        rtol=1e-6,
     )
 
 

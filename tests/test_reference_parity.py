@@ -1,0 +1,225 @@
+"""Differential parity: our GARs vs the REFERENCE'S OWN torch implementations.
+
+VERDICT r2 #4: ``tests/test_gars.py`` checks against hand-written numpy
+oracles — a second implementation of the same spec by the same author. These
+tests instead import ``/root/reference/pytorch_impl/libs/aggregators`` (torch
+CPU is in the image) and assert ELEMENTWISE agreement in float64 across a
+random (n, f, d) grid, for every rule whose reference implementation is
+well-defined:
+
+  krum (default m, m=1, mid m)  — krum.py:65-80
+  median (incl. NaN rows)       — median.py:39
+  average                       — average.py
+  aksel ("mid" and "n-f")       — aksel.py:24-64
+  brute                         — brute.py:31-50
+  condense (fixed mask)         — condense.py:36-42, mask injected on both
+                                  sides so the Bernoulli draw is identical
+  bulyan PHASE 2 (avgmed)       — bulyan.py:77-84 torch composition
+
+DOCUMENTED EXCLUSION — bulyan phase 1 (the selection loop): the reference's
+incremental score update after pruning (bulyan.py:74-76) is provably dead
+code — the guard ``if gid == gid_prune`` can never hold (each gid appears
+once in ``scores`` and the pruned entry was just overwritten with
+``(inf, None)``), and had it ever run, the body reads the UNDEFINED name
+``distance`` (the dict is called ``distances``), i.e. a NameError. The
+reference therefore executes "iterated selection on STALE round-0 scores",
+while this repo implements the Bulyan paper's semantics (re-score the active
+set each round — what the dead update was trying to approximate). The two
+differ on essentially all random inputs (measured: 35/36 of a (n, f, d)
+grid), so full-rule bulyan parity is intentionally not asserted; phase 2 is
+asserted below, and phase 1 is covered by the independent oracle in
+test_gars.py.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+_REF_LIBS = "/root/reference/pytorch_impl/libs"
+
+if not os.path.isdir(_REF_LIBS):
+    pytest.skip("reference tree unavailable", allow_module_level=True)
+
+
+@pytest.fixture(scope="module")
+def env():
+    """(reference gars, our gars), with float64 enabled for the module.
+
+    The reference package builds its native extensions on import; blocking
+    ``import native`` (sys.modules[...] = None makes it raise ImportError)
+    keeps the import fast and pure-torch — exactly the rules the reference
+    itself falls back to without a CUDA toolchain.
+    """
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    sys.modules.setdefault("native", None)
+    sys.path.insert(0, _REF_LIBS)
+    try:
+        import aggregators as ref_aggregators
+
+        from garfield_tpu.aggregators import gars
+
+        yield ref_aggregators.gars, gars
+    finally:
+        sys.path.remove(_REF_LIBS)
+        jax.config.update("jax_enable_x64", False)
+
+
+def _t(g):
+    return [torch.tensor(row) for row in np.asarray(g)]
+
+
+# (n, f) pairs valid for every rule under test (krum needs n >= 2f+3,
+# brute n >= 2f+1, median/condense n >= 2f+2, aksel n >= 2f+1).
+GRID = [(7, 1), (9, 2), (11, 3)]
+DIMS = (5, 64, 301)
+
+
+def _agree(got, want, rtol=1e-6, atol=1e-9):
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=rtol, atol=atol
+    )
+
+
+@pytest.mark.parametrize("n,f", GRID)
+def test_krum_parity(env, n, f):
+    ref, ours = env
+    rng = np.random.default_rng(100 * n + f)
+    for d in DIMS:
+        g = rng.standard_normal((n, d))
+        for m in (None, 1, max(1, (n - f - 2) // 2)):
+            want = ref["krum"].unchecked(gradients=_t(g), f=f, m=m).numpy()
+            got = ours["krum"].unchecked(g, f=f, m=m)
+            _agree(got, want)
+
+
+@pytest.mark.parametrize("n,f", GRID)
+def test_median_parity(env, n, f):
+    ref, ours = env
+    rng = np.random.default_rng(200 * n + f)
+    for d in DIMS:
+        g = rng.standard_normal((n, d))
+        _agree(
+            ours["median"].unchecked(g, f=f),
+            ref["median"].unchecked(gradients=_t(g), f=f).numpy(),
+        )
+    # NaN resilience: the reference DOCUMENTS "NaN-resilient median"
+    # (median.py docstring) but modern torch's ``median(dim=0)`` PROPAGATES
+    # NaN (the doc described the old sort-based lowering, where NaN sorts
+    # last — torch.sort still does). Our median keeps the documented
+    # semantics, so the oracle here is torch's sort-based lower median,
+    # not the propagating ``median(dim=0)`` call.
+    g = rng.standard_normal((n, 33))
+    g[:f] = np.nan
+    want = torch.stack(_t(g)).sort(dim=0).values[(n - 1) // 2].numpy()
+    assert np.isfinite(want).all()
+    _agree(ours["median"].unchecked(g, f=f), want)
+
+
+@pytest.mark.parametrize("n,f", GRID)
+def test_average_parity(env, n, f):
+    ref, ours = env
+    rng = np.random.default_rng(300 * n + f)
+    g = rng.standard_normal((n, 129))
+    _agree(
+        ours["average"].unchecked(g, f=f),
+        ref["average"].unchecked(gradients=_t(g), f=f).numpy(),
+    )
+
+
+@pytest.mark.parametrize("mode", ["mid", "n-f"])
+@pytest.mark.parametrize("n,f", GRID)
+def test_aksel_parity(env, n, f, mode):
+    ref, ours = env
+    rng = np.random.default_rng(400 * n + f)
+    for d in DIMS:
+        g = rng.standard_normal((n, d))
+        want = ref["aksel"].unchecked(gradients=_t(g), f=f, mode=mode)
+        _agree(ours["aksel"].unchecked(g, f=f, mode=mode), want.numpy())
+
+
+@pytest.mark.parametrize("n,f", [(5, 1), (7, 2), (9, 3)])
+def test_brute_parity(env, n, f):
+    # Small n: the reference enumerates C(n, n-f) subsets in Python.
+    ref, ours = env
+    rng = np.random.default_rng(500 * n + f)
+    for d in (5, 64):
+        g = rng.standard_normal((n, d))
+        _agree(
+            ours["brute"].unchecked(g, f=f),
+            ref["brute"].unchecked(gradients=_t(g), f=f).numpy(),
+        )
+
+
+@pytest.mark.parametrize("n,f", GRID)
+def test_krum_nonfinite_row_parity(env, n, f):
+    """A Byzantine row of NaN/Inf poisons its distances to +inf on both
+    sides (krum.py:44-48) and must never be selected."""
+    ref, ours = env
+    rng = np.random.default_rng(600 * n + f)
+    g = rng.standard_normal((n, 65))
+    g[0, 0] = np.nan
+    g[1, -1] = np.inf if f >= 2 else g[1, -1]
+    _agree(
+        ours["krum"].unchecked(g, f=f),
+        ref["krum"].unchecked(gradients=_t(g), f=f).numpy(),
+    )
+
+
+@pytest.mark.parametrize("n,f", GRID)
+def test_condense_parity_fixed_mask(env, n, f, monkeypatch):
+    """condense.py:36-42 with the Bernoulli mask pinned identically on both
+    sides (the reference draws from the torch global RNG, ours from an
+    explicit jax key — inject the same mask into both)."""
+    import jax.numpy as jnp
+
+    ref, ours = env
+    rng = np.random.default_rng(700 * n + f)
+    d = 129
+    g = rng.standard_normal((n, d))
+    mask = rng.integers(0, 2, d).astype(np.float64)
+
+    monkeypatch.setattr(
+        torch.distributions.bernoulli.Bernoulli,
+        "sample",
+        # Fresh tensor per call: the reference mutates the sample in place
+        # (c.neg_().add_(1), condense.py:40).
+        lambda self, *a, **k: torch.tensor(mask.copy()),
+    )
+    import jax
+
+    monkeypatch.setattr(
+        jax.random, "bernoulli", lambda key, p, shape: jnp.asarray(mask > 0)
+    )
+    want = ref["condense"].unchecked(gradients=_t(g), f=f, p=0.5).numpy()
+    got = ours["condense"].unchecked(g, f=f, p=0.5)
+    _agree(got, want)
+
+
+@pytest.mark.parametrize("s,f", [(5, 1), (9, 2), (13, 3)])
+def test_bulyan_phase2_parity(env, s, f):
+    """Coordinate-wise averaged median vs the reference's own torch
+    composition (bulyan.py:77-84: median -> abs deviation -> topk smallest
+    -> take -> mean), on non-tie random inputs (topk's order among exactly
+    equal deviations is unspecified; random doubles never tie)."""
+    env  # fixture keeps x64 on for the jax side
+    from garfield_tpu import ops
+
+    rng = np.random.default_rng(800 * s + f)
+    beta = s - 2 * f
+    for d in DIMS:
+        sel = rng.standard_normal((s, d))
+        t = torch.tensor(sel)
+        median = t.median(dim=0).values
+        closest = (
+            t.clone().sub_(median).abs_()
+            .topk(beta, dim=0, largest=False, sorted=False).indices
+        )
+        closest.mul_(d).add_(torch.arange(0, d, dtype=closest.dtype))
+        want = t.take(closest).mean(dim=0).numpy()
+        _agree(ops.averaged_median_mean(sel, beta), want)

@@ -128,6 +128,11 @@ def test_evalset_matches_list_path():
     )
     assert got_b == want_b
 
+    # ADVICE r2: empty test_batches must raise a clear error, not an
+    # opaque jnp.stack failure.
+    with pytest.raises(ValueError, match="at least one test batch"):
+        parallel.EvalSet([])
+
 
 def test_gar_bench_smoke():
     from garfield_tpu.apps.benchmarks import gar_bench
