@@ -30,7 +30,7 @@ from ..utils import selectors, tools
 
 _PAGE = """<!doctype html>
 <html><head><title>garfield-tpu LEARN demo</title></head>
-<body style="font-family:sans-serif;max-width:40em;margin:2em auto">
+<body style="font-family:sans-serif;max-width:44em;margin:2em auto">
 <h2>Byzantine-resilient collaborative learning (LEARN, Pima)</h2>
 <form onsubmit="start(event)">
   nodes <input id=n value=8 size=2>
@@ -42,6 +42,12 @@ _PAGE = """<!doctype html>
   epochs <input id=e value=15 size=3>
   <button>train</button>
 </form>
+<!-- Topology sketch + per-node progress: the reference demo's observable
+     behavior (LEARN/static/network_topologies.svg + per-node rows in
+     templates/index.html). LEARN is fully connected; Byzantine nodes (the
+     last f ranks, trainer rank convention) draw red. -->
+<svg id=topo width=440 height=300></svg>
+<div id=nodes></div>
 <pre id=out>idle</pre>
 <script>
 async function start(ev) {
@@ -54,8 +60,40 @@ async function start(ev) {
     epochs:+document.getElementById('e').value})});
   poll();
 }
+function drawTopo(r) {
+  const svg = document.getElementById('topo');
+  const losses = r.node_losses || [], byz = r.byz_nodes || [];
+  const n = losses.length;
+  if (!n) { svg.innerHTML = ''; return; }
+  const cx = 220, cy = 150, R = 110;
+  const pos = [...Array(n)].map((_, i) => {
+    const a = 2 * Math.PI * i / n - Math.PI / 2;
+    return [cx + R * Math.cos(a), cy + R * Math.sin(a)];
+  });
+  let s = '';
+  for (let i = 0; i < n; i++)           // fully-connected gossip edges
+    for (let j = i + 1; j < n; j++)
+      s += `<line x1=${pos[i][0]} y1=${pos[i][1]} x2=${pos[j][0]} ` +
+           `y2=${pos[j][1]} stroke="#ddd"/>`;
+  for (let i = 0; i < n; i++) {
+    const c = byz[i] ? '#c0392b' : '#27ae60';
+    s += `<circle cx=${pos[i][0]} cy=${pos[i][1]} r=14 fill="${c}"/>` +
+         `<text x=${pos[i][0]} y=${pos[i][1] + 4} text-anchor=middle ` +
+         `fill=white font-size=11>${i}</text>` +
+         `<text x=${pos[i][0]} y=${pos[i][1] + 28} text-anchor=middle ` +
+         `font-size=10>${byz[i] ? 'byz' : (+losses[i]).toFixed(3)}</text>`;
+  }
+  svg.innerHTML = s;
+}
+function drawNodes(r) {
+  const losses = r.node_losses || [], byz = r.byz_nodes || [];
+  document.getElementById('nodes').innerHTML = losses.map((l, i) =>
+    `<div>node ${i}: ${byz[i] ? '<b style="color:#c0392b">byzantine</b>'
+       : 'loss ' + (+l).toFixed(4)}</div>`).join('');
+}
 async function poll() {
   const r = await (await fetch('/status')).json();
+  drawTopo(r); drawNodes(r);
   document.getElementById('out').textContent = JSON.stringify(r, null, 1);
   if (r.running) setTimeout(poll, 500);
 }
@@ -114,24 +152,33 @@ def run_training(nodes, f, gar, attack, epochs, batch=16):
         state = init_fn(jax.random.PRNGKey(1234), xs[0, 0])
         xs = jax.device_put(jax.numpy.asarray(xs), step_fn.batch_sharding)
         ys = jax.device_put(jax.numpy.asarray(ys), step_fn.batch_sharding)
+        # Byzantine ranks are the LAST f (core.default_byz_mask, the
+        # trainer rank convention) — rendered red in the topology sketch.
+        byz = [False] * nodes
+        if attack not in (None, "none") and f:
+            byz = [i >= nodes - f for i in range(nodes)]
         metrics = {}
+
+        def publish(i, metrics, running, done=False):
+            acc = parallel.compute_accuracy(state, eval_fn, test, binary=True)
+            STATE.update(
+                running=running, step=i + 1, total=total,
+                epoch=i // iters_per_epoch,
+                loss=float(metrics["loss"]), accuracy=acc,
+                node_losses=[
+                    round(float(l), 5)
+                    for l in np.asarray(metrics["node_losses"])
+                ],
+                byz_nodes=byz, done=done,
+                elapsed_s=round(time.time() - t0, 1),
+            )
+
         for i in range(total):
             state, metrics = step_fn(state, xs[:, i % iters_per_epoch],
                                      ys[:, i % iters_per_epoch])
             if i % iters_per_epoch == 0 or i == total - 1:
-                acc = parallel.compute_accuracy(
-                    state, eval_fn, test, binary=True
-                )
-                STATE.update(
-                    running=True, step=i + 1, total=total,
-                    epoch=i // iters_per_epoch,
-                    loss=float(metrics["loss"]), accuracy=acc,
-                    elapsed_s=round(time.time() - t0, 1),
-                )
-        acc = parallel.compute_accuracy(state, eval_fn, test, binary=True)
-        STATE.update(running=False, step=total, accuracy=acc,
-                     loss=float(metrics["loss"]),
-                     elapsed_s=round(time.time() - t0, 1), done=True)
+                publish(i, metrics, running=True)
+        publish(total - 1, metrics, running=False, done=True)
     except Exception as exc:  # surfaced via /status, like demo.py's liveness
         STATE.update(running=False, error=repr(exc))
 

@@ -267,6 +267,12 @@ def make_trainer(
         loss_num = jax.lax.psum(jnp.sum(losses * honest), axis)
         loss_den = jax.lax.psum(jnp.sum(honest), axis)
         mean_loss = loss_num / jnp.maximum(loss_den, 1.0)
+        # Per-node losses for observers (the reference demo renders per-node
+        # progress, LEARN/demo.py:401-441 + templates/index.html); a tiny
+        # replicated (n,) vector, node-id ordered.
+        metrics_extra["node_losses"] = jax.lax.all_gather(
+            losses, axis, tiled=True
+        )
 
         return (
             state.replace(

@@ -153,7 +153,7 @@ class PeerExchange:
 
         Once connected, the socket's timeout is reset to ``send_timeout_ms``
         — the connect timeout must NOT govern ``sendall`` (a multi-MB model
-        frame cannot ship in the 100 ms reconnect window), while a hung
+        frame cannot ship inside the short reconnect window), while a hung
         (not crashed) receiver still cannot block publish forever.
         """
         sock = self._send_socks.get(idx)

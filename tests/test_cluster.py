@@ -72,10 +72,23 @@ def test_worker_crash_survivors_converge(tmp_path):
     env.pop("PALLAS_AXON_POOL_IPS", None)  # keep subprocesses off the TPU
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = _REPO
+    # This test is about crash tolerance, not task difficulty: pin an easy
+    # surrogate margin so 60 steps show clear learning (the default margin
+    # is deliberately hard — hundreds of steps to climb; data/__init__.py).
+    env["GARFIELD_SURROGATE_MARGIN"] = "30"
+    env["GARFIELD_SURROGATE_LABEL_NOISE"] = "0"
 
     ps = _launch("ps:0", cfg_path, env)
     workers = [_launch(f"worker:{w}", cfg_path, env) for w in range(n_w)]
     victim = workers[-1]
+    # Watchdog: the stdout readline loop below blocks on a silent-but-alive
+    # PS, so bound the whole test from a side thread instead.
+    import threading
+
+    watchdog = threading.Timer(
+        420, lambda: [p.kill() for p in [ps, *workers]]
+    )
+    watchdog.start()
     try:
         # Wait for training to be demonstrably under way (the step-10
         # accuracy line), then SIGKILL one worker — a hard crash, not an
@@ -114,6 +127,7 @@ def test_worker_crash_survivors_converge(tmp_path):
             assert wsum["steps"] >= 50
         assert victim.wait(timeout=60) == -signal.SIGKILL
     finally:
+        watchdog.cancel()
         for p in [ps, *workers]:
             if p.poll() is None:
                 p.kill()

@@ -49,6 +49,13 @@ def test_demo_trains_via_http():
         assert final and final.get("done"), f"timed out: {final}"
         assert 0.0 <= final["accuracy"] <= 1.0
         assert final["step"] == final["total"]
+        # Per-node progress + topology data (VERDICT r2 #8): one loss per
+        # node, Byzantine flags on the last f ranks, rendered by the page.
+        assert len(final["node_losses"]) == 4
+        assert final["byz_nodes"] == [False, False, False, True]
+        assert all(l == l for l in final["node_losses"][:3])  # honest finite
+        status, page = _request(port, "GET", "/")
+        assert b"drawTopo" in page and b"node_losses" in page
 
         status, _ = _request(port, "GET", "/nope")
         assert status == 404
