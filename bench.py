@@ -17,7 +17,9 @@ ResNet-18 estimate) / measured step time / the chip's peak bf16 FLOP/s.
 
 Env knobs: GARFIELD_BENCH_STEPS (timed steps, default 20),
 GARFIELD_BENCH_WORKERS, GARFIELD_BENCH_F, GARFIELD_BENCH_BATCH,
-GARFIELD_BENCH_ATTEMPTS (transient-failure retries, default 5).
+GARFIELD_BENCH_ATTEMPTS (transient-failure retries, default 5),
+GARFIELD_BENCH_F32_GAR (set to disable the default bf16 aggregation
+pipeline on TPU and run the GAR phase at full width).
 
 The tunneled backend can drop a single HTTP response mid-compile
 ("remote_compile: read body: response body closed" — see BENCH_r02.json);
@@ -143,6 +145,16 @@ def main():
     init_fn, step_fn, _ = aggregathor.make_trainer(
         module, loss_fn, opt, "krum",
         num_workers=num_workers, f=f, attack="lie", mesh=mesh,
+        # bf16 aggregation pipeline on TPU (half the HBM/ICI bytes through
+        # attack+gather+GAR; Gram still accumulates f32): +~2% on one chip
+        # (PERF.md r3), the honest TPU-first default. GARFIELD_BENCH_F32_GAR
+        # restores the full-width pipeline.
+        gar_dtype=(
+            jnp.bfloat16
+            if platform == "tpu"
+            and not os.environ.get("GARFIELD_BENCH_F32_GAR")
+            else None
+        ),
     )
 
     rng = np.random.default_rng(1234)

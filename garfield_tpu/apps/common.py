@@ -78,6 +78,11 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
     a("--dtype", type=str, default="float32",
       choices=["float32", "bfloat16"],
       help="Model compute dtype (bfloat16 routes matmuls to the MXU).")
+    a("--gar_dtype", type=str, default=None,
+      choices=["float32", "bfloat16"],
+      help="Aggregation-pipeline dtype: bfloat16 halves the HBM traffic of "
+           "the attack+gather+GAR phase (Gram still accumulates in f32); "
+           "default: full width.")
     a("--fault_crashes", type=json.loads, default=None,
       help='Host crash schedule as JSON {"host": step, ...}: from the given '
            "step on, that simulated host's worker slots feed zero gradients "
@@ -257,6 +262,11 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
 
     def build(step):
         kwargs = dict(make_trainer_kwargs)
+        if getattr(args, "gar_dtype", None):
+            kwargs["gar_dtype"] = (
+                jnp.bfloat16 if args.gar_dtype == "bfloat16"
+                else jnp.float32
+            )
         if sched is not None:
             kwargs["attack"] = "crash"
             kwargs[mask_key] = sched.byz_mask(step, num_slots)

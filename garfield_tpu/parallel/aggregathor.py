@@ -112,6 +112,7 @@ def make_trainer(
     subset=None,
     granularity="model",
     tree_path=True,
+    gar_dtype=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the SSMW topology.
 
@@ -124,6 +125,16 @@ def make_trainer(
     (average, krum) skip the (n, d) flat stack entirely — measured ~5 ms/
     step at ResNet-18 scale (PERF.md); set False to force the flat path
     (A/B tests).
+
+    ``gar_dtype`` (e.g. ``jnp.bfloat16``) casts the per-worker gradients to
+    that dtype at the backward's epilogue (XLA fuses the cast into its final
+    writes, so the f32 gradients never hit HBM) and runs the attack + gather
+    + GAR phase entirely at the narrow width — halving the HBM traffic of
+    the whole aggregation pipeline, which is bandwidth-bound (PERF.md
+    "Known frontier"). Gram/selection arithmetic still accumulates in f32
+    (aggregators/_common.py), and the aggregate is cast back to the param
+    dtype at the optimizer boundary — the standard bf16-gradient-exchange
+    design on TPU. None keeps full width.
 
     ``step_fn(state, x, y) -> (state, metrics)`` expects ``x``/``y`` with a
     leading ``num_workers`` axis, sharded over ``axis``; it is jit'd with
@@ -183,6 +194,9 @@ def make_trainer(
         grads_local, (loss_local, ms_local) = core.per_slot_grads(
             grad_fn, params, ms, x_local, y_local, drop_keys
         )
+        # Narrow the aggregation pipeline (see make_trainer docstring); the
+        # cast fuses into the backward's output writes. No-op when None.
+        grads_local = core.cast_leaves(grads_local, gar_dtype)
 
         # all_gather over the mesh axis == Server.get_gradients (RPC gather).
         grads = jax.tree.map(
@@ -231,6 +245,8 @@ def make_trainer(
             )
             aggr_tree = core.unflatten_like(params, aggr)
 
+        if gar_dtype is not None:
+            aggr_tree = core.cast_like(aggr_tree, params)
         updates, new_opt = optimizer.update(aggr_tree, state.opt_state, params)
         new_params = optax.apply_updates(params, updates)
         new_state = state.replace(

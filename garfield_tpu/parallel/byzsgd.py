@@ -71,6 +71,7 @@ def make_trainer(
     model_gar=None,
     granularity="model",
     tree_path=True,
+    gar_dtype=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the MSMW topology.
 
@@ -88,6 +89,11 @@ def make_trainer(
     krum) run the gradient phase on the stacked gradient TREE — no
     (n_w, d) flat stack per PS slot (same win as aggregathor's tree path,
     PERF.md); the model gather phase always works on flat model vectors.
+
+    ``gar_dtype`` narrows the gradient-phase pipeline (cast at the backward
+    epilogue, attack + gather + GAR at the narrow width, cast back at the
+    optimizer boundary) exactly like aggregathor's flag; the model-space
+    phase stays full width (models are parameters, not gradients).
 
     ``step_fn(state, x, y)``: ``x``/``y`` lead with ``num_workers`` sharded
     over ``axis``; state params/opt_state lead with ``num_ps`` sharded over
@@ -166,9 +172,10 @@ def make_trainer(
             )
         else:
             aggr = gar.unchecked(stack, f=fw, key=gkey)
-        updates, new_opt = optimizer.update(
-            core.unflatten_like(params, aggr), opt_state, params
-        )
+        aggr_tree = core.unflatten_like(params, aggr)
+        if gar_dtype is not None:
+            aggr_tree = core.cast_like(aggr_tree, params)
+        updates, new_opt = optimizer.update(aggr_tree, opt_state, params)
         return optax.apply_updates(params, updates), new_opt
 
     def _local_step(state, x_local, y_local):
@@ -190,6 +197,7 @@ def make_trainer(
             g, (loss, ms_out) = core.per_slot_grads(
                 grad_fn, params, ms, x_local, y_local, keys
             )
+            g = core.cast_leaves(g, gar_dtype)
             if tree_ok:
                 gathered = jax.tree.map(
                     lambda l: jax.lax.all_gather(l, axis, tiled=True), g
@@ -232,6 +240,8 @@ def make_trainer(
                 )
                 p_k = jax.tree.map(lambda l: l[k], state.params)
                 o_k = jax.tree.map(lambda l: l[k], state.opt_state)
+                if gar_dtype is not None:
+                    aggr_tree = core.cast_like(aggr_tree, p_k)
                 updates, o_k = optimizer.update(aggr_tree, o_k, p_k)
                 new_params_list.append(optax.apply_updates(p_k, updates))
                 new_opt_list.append(o_k)

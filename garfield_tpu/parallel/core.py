@@ -177,6 +177,25 @@ def per_slot_grads(grad_fn, params, ms, x, y, keys):
     return jax.tree.map(lambda *ls: jnp.stack(ls), *outs)
 
 
+def cast_leaves(tree, dtype):
+    """Cast every leaf to ``dtype`` (no-op when dtype is None).
+
+    The narrow-aggregation-pipeline cast-IN: applied to per-slot gradients
+    at the backward epilogue so XLA fuses it into the backward's output
+    writes (``gar_dtype`` in the topology builders).
+    """
+    if dtype is None:
+        return tree
+    return jax.tree.map(lambda l: l.astype(dtype), tree)
+
+
+def cast_like(tree, ref_tree):
+    """Cast every leaf of ``tree`` to the dtype of the matching ``ref_tree``
+    leaf — the cast-BACK at the optimizer boundary (momentum/weight-decay
+    state stays full width)."""
+    return jax.tree.map(lambda a, p: a.astype(p.dtype), tree, ref_tree)
+
+
 def subset_indices(key, n, q):
     """Uniformly sample q of n row indices (static shape (q,)).
 

@@ -71,6 +71,7 @@ def make_trainer(
     model_gossip=True,
     subset=None,
     track_spread=False,
+    gar_dtype=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the LEARN topology.
 
@@ -86,6 +87,10 @@ def make_trainer(
     metrics — the max pairwise L-inf distance between honest nodes'
     aggregates before and after the agreement rounds (costs one extra
     (n, d) all_gather; leave off in production).
+    ``gar_dtype`` narrows the gradient pipeline (cast at the backward
+    epilogue; gathers, attacks, aggregation and agreement rounds run at
+    the narrow width; cast back at the optimizer boundary) — aggregathor's
+    flag, applied to LEARN's phases 2-4. Model gossip stays full width.
     ``step_fn(state, x, y)``: leading ``num_nodes`` axis on x/y and on every
     params/opt_state leaf, all sharded over ``axis``.
     """
@@ -183,6 +188,7 @@ def make_trainer(
             ms_list.append(ms_out)
         grads_local = jax.tree.map(lambda *ls: jnp.stack(ls), *grads)
         losses = jnp.stack(losses)
+        grads_local = core.cast_leaves(grads_local, gar_dtype)
         new_ms = core.mean_model_state(
             jax.tree.map(lambda *ls: jnp.stack(ls), *ms_list), axis
         )
@@ -233,9 +239,10 @@ def make_trainer(
         for k in range(per_n):
             p_k = jax.tree.map(lambda l: l[k], state.params)
             o_k = jax.tree.map(lambda l: l[k], state.opt_state)
-            updates, o_k = optimizer.update(
-                core.unflatten_like(p_k, aggr_local[k]), o_k, p_k
-            )
+            aggr_tree = core.unflatten_like(p_k, aggr_local[k])
+            if gar_dtype is not None:
+                aggr_tree = core.cast_like(aggr_tree, p_k)
+            updates, o_k = optimizer.update(aggr_tree, o_k, p_k)
             new_params_list.append(optax.apply_updates(p_k, updates))
             new_opt_list.append(o_k)
         new_params = jax.tree.map(lambda *ls: jnp.stack(ls), *new_params_list)

@@ -33,7 +33,7 @@ TARGETS = (0.5, 0.7, 0.9)
 
 
 def run_one(f, *, iters, eval_every, lr, gar=None, num_workers=9,
-            batch=25):
+            batch=25, attack="lie"):
     from garfield_tpu import data, models, parallel
     from garfield_tpu.parallel import aggregathor, mesh as mesh_lib
     from garfield_tpu.utils import selectors
@@ -47,7 +47,7 @@ def run_one(f, *, iters, eval_every, lr, gar=None, num_workers=9,
     )
     if gar is None:
         gar = "krum" if f else "average"
-    attack = "lie" if f else None
+    attack = attack if f else None
     mesh = mesh_lib.make_mesh({"workers": 1}, devices=jax.devices()[:1])
     init_fn, step_fn, eval_fn = aggregathor.make_trainer(
         module, loss_fn, opt, gar,
@@ -95,6 +95,10 @@ def main(argv=None):
                    help="Override the rule (default: krum for f>0, "
                         "average for f=0); e.g. bulyan needs n >= 4f+3.")
     p.add_argument("--workers", type=int, default=9)
+    p.add_argument("--attack", type=str, default="lie",
+                   help="Gradient attack for f > 0 rows (lie is the "
+                        "literature's defense-breaking default; reverse/"
+                        "random are the classic attacks robust rules beat).")
     p.add_argument("--lr", type=float, default=0.05,
                    help="SGD lr; the reference 0.2 makes krum-vs-lie at "
                    "f>=2 oscillate without converging on this task — "
@@ -112,11 +116,12 @@ def main(argv=None):
         print(f"=== f={f} ===", flush=True)
         results.append(run_one(
             f, iters=args.iters, eval_every=args.eval_every, lr=args.lr,
-            gar=args.gar, num_workers=args.workers,
+            gar=args.gar, num_workers=args.workers, attack=args.attack,
         ))
     artifact = {
-        "config": "resnet18/cifar10, 9 workers x batch 25, krum+lie (f>0) "
-                  f"or average (f=0), SGD lr {args.lr} m 0.9 wd 5e-4",
+        "config": "resnet18/cifar10, batch 25/worker, SGD lr "
+                  f"{args.lr} m 0.9 wd 5e-4; rule/attack/worker-count are "
+                  "PER ROW (gar/attack/num_workers fields)",
         "data": "real cifar10 files" if real else
                 "deterministic synthetic surrogate (no dataset files; see "
                 "scripts/fetch_data.py)",
@@ -135,7 +140,8 @@ def main(argv=None):
         else:
             # .get defaults keep hand-edited / older-schema rows mergeable
             # instead of silently destroying them.
-            key = lambda r: (r.get("f"), r.get("gar"), r.get("num_workers"))
+            key = lambda r: (r.get("f"), r.get("gar"), r.get("num_workers"),
+                             r.get("attack"))
             done = {key(r) for r in results}
             artifact["results"] = sorted(
                 results + [

@@ -174,6 +174,29 @@ class TestAggregathor:
                 module, loss, opt, "krum", num_workers=4, f=2
             )
 
+    def test_bf16_gar_pipeline_converges(self):
+        """gar_dtype=bfloat16 (narrow aggregation pipeline, the TPU HBM
+        lever in PERF.md) must train like the f32 pipeline: loss drops,
+        params stay finite, trajectories track each other loosely (bf16
+        rounding makes them non-bitwise by design)."""
+        module, loss, opt = _pima_setup()
+        x, y = _pima_batches(8, 16)
+        runs = {}
+        for dt in (None, jnp.bfloat16):
+            init_fn, step_fn, _ = aggregathor.make_trainer(
+                module, loss, opt, "krum", num_workers=8, f=2, attack="lie",
+                gar_dtype=dt,
+            )
+            state = init_fn(jax.random.PRNGKey(0), x[0])
+            state, losses = _run(step_fn, state, x, y, 30)
+            assert all(np.isfinite(l) for l in losses)
+            for leaf in jax.tree.leaves(jax.device_get(state.params)):
+                assert np.isfinite(leaf).all()
+            runs[dt] = losses
+        assert runs[jnp.bfloat16][-1] < runs[jnp.bfloat16][0] * 0.8
+        # Same task, same seeds: end-of-run losses agree to bf16-ish slack.
+        assert abs(runs[None][-1] - runs[jnp.bfloat16][-1]) < 0.15
+
     def test_accuracy_eval(self):
         module, loss, opt = _pima_setup()
         x, y = _pima_batches(8, 16)
@@ -215,6 +238,31 @@ class TestAggregathor:
 
 
 class TestByzSGD:
+    def test_gar_dtype_smoke_byzsgd_learn(self):
+        """gar_dtype=bfloat16 plumbs through the ByzSGD gradient phase and
+        LEARN's phases 2-4: steps run, losses stay finite and decrease."""
+        module, loss, opt = _pima_setup()
+        x, y = _pima_batches(8, 16)
+        mesh = make_mesh({"ps": 2, "workers": 4})
+        init_fn, step_fn, _ = byzsgd.make_trainer(
+            module, loss, opt, "krum", num_workers=8, num_ps=4, fw=2,
+            fps=1, attack="reverse", ps_attack="random", mesh=mesh,
+            model_gar="median", gar_dtype=jnp.bfloat16,
+        )
+        state = init_fn(jax.random.PRNGKey(0), x[0])
+        state, losses = _run(step_fn, state, x, y, 15)
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+        init_fn, step_fn, _ = learn.make_trainer(
+            module, loss, opt, "median", num_nodes=8, f=1, attack="empire",
+            non_iid=True, gar_dtype=jnp.bfloat16,
+        )
+        state = init_fn(jax.random.PRNGKey(1), x[0])
+        state, losses = _run(step_fn, state, x, y, 15)
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
     def test_replicated_ps_under_both_attacks(self):
         module, loss, opt = _pima_setup()
         x, y = _pima_batches(8, 16)
