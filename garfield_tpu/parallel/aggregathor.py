@@ -245,8 +245,7 @@ def make_trainer(
             )
             aggr_tree = core.unflatten_like(params, aggr)
 
-        if gar_dtype is not None:
-            aggr_tree = core.cast_like(aggr_tree, params)
+        aggr_tree = core.cast_like(aggr_tree, params)  # no-op at f32
         updates, new_opt = optimizer.update(aggr_tree, state.opt_state, params)
         new_params = optax.apply_updates(params, updates)
         new_state = state.replace(

@@ -173,8 +173,7 @@ def make_trainer(
         else:
             aggr = gar.unchecked(stack, f=fw, key=gkey)
         aggr_tree = core.unflatten_like(params, aggr)
-        if gar_dtype is not None:
-            aggr_tree = core.cast_like(aggr_tree, params)
+        aggr_tree = core.cast_like(aggr_tree, params)  # no-op at f32
         updates, new_opt = optimizer.update(aggr_tree, opt_state, params)
         return optax.apply_updates(params, updates), new_opt
 
@@ -240,8 +239,7 @@ def make_trainer(
                 )
                 p_k = jax.tree.map(lambda l: l[k], state.params)
                 o_k = jax.tree.map(lambda l: l[k], state.opt_state)
-                if gar_dtype is not None:
-                    aggr_tree = core.cast_like(aggr_tree, p_k)
+                aggr_tree = core.cast_like(aggr_tree, p_k)  # no-op at f32
                 updates, o_k = optimizer.update(aggr_tree, o_k, p_k)
                 new_params_list.append(optax.apply_updates(p_k, updates))
                 new_opt_list.append(o_k)

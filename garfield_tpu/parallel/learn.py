@@ -240,8 +240,7 @@ def make_trainer(
             p_k = jax.tree.map(lambda l: l[k], state.params)
             o_k = jax.tree.map(lambda l: l[k], state.opt_state)
             aggr_tree = core.unflatten_like(p_k, aggr_local[k])
-            if gar_dtype is not None:
-                aggr_tree = core.cast_like(aggr_tree, p_k)
+            aggr_tree = core.cast_like(aggr_tree, p_k)  # no-op at f32
             updates, o_k = optimizer.update(aggr_tree, o_k, p_k)
             new_params_list.append(optax.apply_updates(p_k, updates))
             new_opt_list.append(o_k)

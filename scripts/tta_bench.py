@@ -140,8 +140,13 @@ def main(argv=None):
         else:
             # .get defaults keep hand-edited / older-schema rows mergeable
             # instead of silently destroying them.
-            key = lambda r: (r.get("f"), r.get("gar"), r.get("num_workers"),
-                             r.get("attack"))
+            # Rows from before --attack existed carry no attack field; they
+            # were all lie (f>0) — normalize so re-running the default
+            # sweep retires them instead of duplicating the config.
+            key = lambda r: (
+                r.get("f"), r.get("gar"), r.get("num_workers"),
+                r.get("attack", "lie" if r.get("f") else None),
+            )
             done = {key(r) for r in results}
             artifact["results"] = sorted(
                 results + [
