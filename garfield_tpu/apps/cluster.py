@@ -178,7 +178,31 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
     d_bytes = flat.size * 4
     good_ranks = list(worker_ranks)
     losses_seen = 0
-    for i in range(args.num_iter):
+    # PS-side checkpoint/resume (utils/checkpoint.py — the deliberate
+    # upgrade over the reference, which has none; the on-mesh analog with
+    # sharded TrainState + bit-exact rng replay lives in common.train).
+    # Only the PS needs state: resumed workers request model round 0, and
+    # read_latest's catch-up semantics jump them straight to the PS's
+    # resumed round.
+    ckpt = None
+    start_iter = last_saved = 0
+    if args.checkpoint_dir:
+        from ..utils import checkpoint as ckpt_lib
+
+        ckpt = ckpt_lib.Checkpointer(args.checkpoint_dir)
+        step = ckpt.latest_step()
+        if args.resume and step is not None:
+            restored = ckpt.restore(
+                {"flat": flat, "opt_state": jax.tree.map(
+                    np.asarray, opt_state)},
+                step=step,
+            )
+            flat = np.asarray(restored["flat"], np.float32)
+            flat_dev = jnp.asarray(flat)
+            opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
+            start_iter = last_saved = int(step)
+            print(f"[cluster-ps] resumed from step {start_iter}", flush=True)
+    for i in range(start_iter, args.num_iter):
         ex.publish(i, flat.tobytes(), to=worker_ranks)
         # A Byzantine PROCESS controls its wire bytes, not just its values:
         # a wrong-length payload cannot enter the GAR (frombuffer/stack
@@ -230,6 +254,12 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
         )
         flat = np.asarray(flat_dev, np.float32)  # next step's publication
         losses_seen = i + 1
+        if ckpt and args.checkpoint_freq and (i + 1) % args.checkpoint_freq == 0:
+            ckpt.save(i + 1, {
+                "flat": flat,
+                "opt_state": jax.tree.map(np.asarray, opt_state),
+            })
+            last_saved = i + 1
         if args.acc_freq and i % args.acc_freq == 0:
             acc = acc_eval(flat_dev)
             print(
@@ -241,6 +271,16 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
     # (including stragglers that skipped rounds) training is over.
     ex.publish(args.num_iter, b"", to=worker_ranks)
     acc = acc_eval(flat_dev)
+    if ckpt:
+        if args.checkpoint_freq and last_saved != args.num_iter:
+            # Final save, skipped when the in-loop save already wrote this
+            # exact step (orbax writes are synchronous; workers idle
+            # meanwhile).
+            ckpt.save(args.num_iter, {
+                "flat": flat,
+                "opt_state": jax.tree.map(np.asarray, opt_state),
+            })
+        ckpt.close()
     summary = {
         "final_accuracy": acc,
         "steps": losses_seen,

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Cross-process cluster fan-out: the TRUE wait-n-f deployment
+# (apps/cluster.py over PeerExchange), one OS process per node.
+#
+# Counterpart of the reference's per-app run_exp.sh ssh loops
+# (Aggregathor/run_exp.sh:41-60) for the host-driver mode: the FIRST host
+# in <hosts_file> is the trusted PS (rank 0, AggregaThor SSMW), the rest
+# are workers; each process binds its own "host:port" endpoint from the
+# shared cluster config and exchanges models/gradients over TCP + the
+# native MRMW register. Unlike run_exp.sh (one jax.distributed
+# multi-controller program), processes here are INDEPENDENT — kill a
+# worker and the PS keeps training on the q = n_w - fw fastest gradients.
+#
+# Usage:
+#   scripts/run_cluster.sh <hosts_file> [app args...]
+# e.g.
+#   scripts/run_cluster.sh nodes --dataset cifar10 --model resnet18 \
+#       --batch 25 --fw 2 --gar median --num_iter 10000
+#
+# Each line of <hosts_file> is "host[:port]" (default port 7600+rank).
+# Requires passwordless ssh and this repo at the same path on every host.
+set -euo pipefail
+
+HOSTS_FILE=${1:?hosts file}
+shift 1
+
+mapfile -t HOSTS < <(grep -v '^#' "$HOSTS_FILE" | sed '/^$/d')
+NUM=${#HOSTS[@]}
+(( NUM >= 2 )) || { echo "need >= 2 hosts (1 PS + workers)"; exit 1; }
+REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
+
+# Normalize "host" -> "host:port" with a default per-rank port.
+ENDPOINTS=()
+for i in "${!HOSTS[@]}"; do
+  H=${HOSTS[$i]}
+  [[ "$H" == *:* ]] || H="$H:$((7600 + i))"
+  ENDPOINTS+=("$H")
+done
+
+CONFIG_JSON=$(python3 - "${ENDPOINTS[@]}" <<'PY'
+import json, sys
+eps = sys.argv[1:]
+print(json.dumps({
+    "cluster": {"ps": eps[:1], "worker": eps[1:]},
+    "task": {"type": "ps", "index": 0},
+}))
+PY
+)
+
+APP_ARGS=""
+for arg in "$@"; do
+  APP_ARGS+=$(printf ' %q' "$arg")
+done
+
+echo "launching cluster: PS on ${ENDPOINTS[0]}, $((NUM - 1)) workers"
+for i in "${!ENDPOINTS[@]}"; do
+  HOST=${ENDPOINTS[$i]%%:*}
+  if (( i == 0 )); then TASK="ps:0"; else TASK="worker:$((i - 1))"; fi
+  ssh -o StrictHostKeyChecking=no "$HOST" \
+    "cd '$REPO_DIR' && printf '%s' '$CONFIG_JSON' > /tmp/garfield_cluster.json && \
+     nohup python3 -m garfield_tpu.apps.aggregathor \
+       --cluster /tmp/garfield_cluster.json --task $TASK$APP_ARGS \
+     > run_cluster_${TASK/:/_}.log 2>&1 &" &
+done
+wait
+echo "all ranks launched; logs: run_cluster_*.log on each host"

@@ -124,6 +124,45 @@ def test_byzantine_worker_process_tolerated(tmp_path):
                 p.kill()
 
 
+def test_ps_checkpoint_resume(tmp_path):
+    """PS-side checkpoint/resume: run 30 steps with checkpointing, then
+    relaunch with --resume for 60 — the PS restores step 30 and the
+    workers (which always start expecting round 0) catch up to the resumed
+    round via read_latest, finishing the remaining 30 steps."""
+    n_w = 4
+    cfg_path, env = _cluster_setup(tmp_path, n_w)
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    def run(extra_ps):
+        ps = _launch("ps:0", cfg_path, env, extra=extra_ps)
+        workers = [
+            _launch(f"worker:{w}", cfg_path, env) for w in range(n_w)
+        ]
+        try:
+            out, _ = ps.communicate(timeout=400)
+            assert ps.returncode == 0, f"PS failed:\n{out[-2000:]}"
+            for w in workers:
+                wout, _ = w.communicate(timeout=120)
+                assert w.returncode == 0, f"worker failed:\n{wout[-1500:]}"
+            return out
+        finally:
+            for p in [ps, *workers]:
+                if p.poll() is None:
+                    p.kill()
+
+    base = ("--checkpoint_dir", ckpt_dir, "--checkpoint_freq", "10")
+    run(base + ("--num_iter", "30"))
+
+    # Fresh ports for the second generation of processes.
+    cfg_path, env = _cluster_setup(tmp_path, n_w)
+    out = run(base + ("--resume",))
+    assert "resumed from step 30" in out
+    summary = json.loads(
+        [l for l in out.splitlines() if l.startswith("{")][-1]
+    )
+    assert summary["steps"] == 60
+
+
 def test_worker_crash_survivors_converge(tmp_path):
     n_w = 4
     cfg_path, env = _cluster_setup(tmp_path, n_w)
