@@ -83,6 +83,11 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
       help="Aggregation-pipeline dtype: bfloat16 halves the HBM traffic of "
            "the attack+gather+GAR phase (Gram still accumulates in f32); "
            "default: full width.")
+    a("--worker_momentum", type=float, default=None,
+      help="Worker-momentum beta in [0, 1): workers submit EMA momenta "
+           "instead of raw gradients (Karimireddy et al. 2021) — pairs "
+           "with --gar cclip to survive the lie attack that defeats "
+           "krum/bulyan (BASELINE.md TTA grid). Default: off.")
     a("--fault_crashes", type=json.loads, default=None,
       help='Host crash schedule as JSON {"host": step, ...}: from the given '
            "step on, that simulated host's worker slots feed zero gradients "
@@ -259,6 +264,12 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
         "byz_mask" if "byz_mask" in trainer_params
         else "byz_worker_mask"  # byzsgd naming
     )
+    if (getattr(args, "worker_momentum", None) is not None
+            and "worker_momentum" not in trainer_params):
+        tools.warning(
+            f"[{tag}] --worker_momentum is not supported by this topology; "
+            "ignored"
+        )
 
     def build(step):
         kwargs = dict(make_trainer_kwargs)
@@ -267,6 +278,9 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                 jnp.bfloat16 if args.gar_dtype == "bfloat16"
                 else jnp.float32
             )
+        if (getattr(args, "worker_momentum", None) is not None
+                and "worker_momentum" in trainer_params):
+            kwargs["worker_momentum"] = args.worker_momentum
         if sched is not None:
             kwargs["attack"] = "crash"
             kwargs[mask_key] = sched.byz_mask(step, num_slots)

@@ -113,6 +113,7 @@ def make_trainer(
     granularity="model",
     tree_path=True,
     gar_dtype=None,
+    worker_momentum=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the SSMW topology.
 
@@ -136,6 +137,19 @@ def make_trainer(
     dtype at the optimizer boundary — the standard bf16-gradient-exchange
     design on TPU. None keeps full width.
 
+    ``worker_momentum`` (float beta in [0, 1)) makes every worker submit an
+    exponential moving average ``m_i = (1-beta) g_i + beta m_i`` of its
+    gradients instead of the raw gradient — Karimireddy, He & Jaggi (ICML
+    2021): momentum shrinks honest-gradient variance over time, which is
+    exactly the quantity the "little is enough" lie attack hides inside, so
+    robust rules (their cclip, but also krum/median) regain their guarantees
+    under attacks that defeat them on raw gradients (see BASELINE.md's TTA
+    grid). The per-worker momentum stack lives in ``TrainState.worker_mom``
+    (same dtype as the aggregation pipeline, i.e. ``gar_dtype`` when set);
+    Byzantine rows are re-poisoned by the attack every step, after the
+    honest update — a real Byzantine worker submits whatever it wants
+    regardless of its declared state.
+
     ``step_fn(state, x, y) -> (state, metrics)`` expects ``x``/``y`` with a
     leading ``num_workers`` axis, sharded over ``axis``; it is jit'd with
     replicated state output, so calling it in a loop keeps everything
@@ -151,6 +165,10 @@ def make_trainer(
         )
     n_eff = subset if subset is not None else num_workers
     _check_gar(gar, n_eff, f)
+    if worker_momentum is not None and not (0.0 <= worker_momentum < 1.0):
+        raise ValueError(
+            f"worker_momentum must be in [0, 1), got {worker_momentum}"
+        )
     axis_size = mesh.shape[axis]
     per_shard = mesh_lib.fold(num_workers, axis_size, "workers")
     if attack is not None and attack != "none" and attack not in gradient_attacks:
@@ -166,12 +184,24 @@ def make_trainer(
     def init_fn(key, example_x, seed_rng=None):
         params, model_state = init_worker(key, example_x)
         opt_state = optimizer.init(params)
+        worker_mom = None
+        if worker_momentum is not None:
+            # Momentum lives at the aggregation pipeline's width: it is what
+            # workers exchange, so it shares gar_dtype with the gathered
+            # gradients (bf16 on the TPU bench path).
+            worker_mom = jax.tree.map(
+                lambda p: jnp.zeros(
+                    (num_workers,) + p.shape, gar_dtype or p.dtype
+                ),
+                params,
+            )
         state = core.TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
             model_state=model_state,
             opt_state=opt_state,
             rng=key if seed_rng is None else seed_rng,
+            worker_mom=worker_mom,
         )
         return jax.device_put(state, repl)
 
@@ -204,6 +234,20 @@ def make_trainer(
         )
         losses = jax.lax.all_gather(loss_local, axis, tiled=True)
         new_ms = core.mean_model_state(ms_local, axis)
+
+        # Worker momentum (see make_trainer docstring): every worker submits
+        # its EMA instead of the raw gradient. Elementwise over the stacked
+        # tree, so it composes with the tree-mode AND flat GAR paths below;
+        # the honest update is stored, the attack poisons its rows after.
+        new_mom = state.worker_mom
+        if worker_momentum is not None:
+            beta = jnp.asarray(worker_momentum, jnp.float32)
+            grads = jax.tree.map(
+                lambda m, g: ((1.0 - beta) * g.astype(jnp.float32)
+                              + beta * m.astype(jnp.float32)).astype(g.dtype),
+                state.worker_mom, grads,
+            )
+            new_mom = grads
 
         honest = (~byz_mask).astype(losses.dtype)
         mean_loss = jnp.sum(losses * honest) / jnp.sum(honest)
@@ -253,6 +297,7 @@ def make_trainer(
             params=new_params,
             model_state=new_ms,
             opt_state=new_opt,
+            worker_mom=new_mom,
         )
         return new_state, {"loss": mean_loss}
 

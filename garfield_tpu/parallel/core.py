@@ -52,6 +52,13 @@ class TrainState:
     model_state: dict
     opt_state: object
     rng: jax.Array
+    # Per-worker momentum stack (leading num_workers axis per leaf) when the
+    # topology runs worker momentum (Karimireddy et al. 2021, the companion
+    # of the cclip GAR); None otherwise. Replicated like the rest of the
+    # state (aggregathor's shard_map passes the whole state at P()), so it
+    # costs num_workers x model HBM per device — budget accordingly on
+    # large models.
+    worker_mom: object = None
 
 
 def make_worker_fns(module, loss_fn):

@@ -350,3 +350,109 @@ def test_bf16_gram_flat_tree_agree():
     sc_flat = np.sort(np.asarray(d_flat), axis=1)[:, :k].sum(axis=1)
     sc_tree = np.sort(np.asarray(d_tree), axis=1)[:, :k].sum(axis=1)
     assert np.argmin(sc_flat) == np.argmin(sc_tree)
+
+
+# ---------------------------------------------------------------------------
+# cclip — centered clipping (beyond-reference; aggregators/cclip.py,
+# Karimireddy, He & Jaggi ICML'21). No reference oracle exists; the float64
+# numpy oracle below re-implements the paper's fixed-point update literally.
+
+def np_cclip(g, iters=3, tau=None):
+    g = np.asarray(g, np.float64)
+    n = len(g)
+    # lower coordinate-wise median init (ops.coordinate_median semantics)
+    v = np.sort(g, axis=0)[(n - 1) // 2]
+    for _ in range(iters):
+        dev = g - v
+        norms = np.linalg.norm(dev, axis=1)
+        t = np.median(norms) if tau is None else tau
+        scale = np.minimum(1.0, t / np.maximum(norms, 1e-12))
+        v = v + np.mean(dev * scale[:, None], axis=0)
+    return v
+
+
+@pytest.mark.parametrize("n,f,d", [(7, 2, 16), (9, 2, 33), (8, 3, 10)])
+def test_cclip_golden(n, f, d):
+    g = stack(n, d)
+    got = np.asarray(gars["cclip"](g, f=f))
+    np.testing.assert_allclose(got, np_cclip(g), rtol=1e-4, atol=1e-5)
+
+
+def test_cclip_identical_rows_fixed_point():
+    row = RNG.normal(size=12).astype(np.float32)
+    g = np.tile(row, (9, 1))
+    got = np.asarray(gars["cclip"](g, f=2))
+    np.testing.assert_allclose(got, row, rtol=1e-6)
+
+
+def test_cclip_huge_tau_is_mean():
+    # With tau far above every radius nothing clips: one iteration from any
+    # center lands on the mean, and the mean is the update's fixed point.
+    g = stack(8, 20)
+    got = np.asarray(gars["cclip"](g, f=2, tau=1e9))
+    np.testing.assert_allclose(
+        got, g.astype(np.float64).mean(axis=0), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_cclip_bounded_influence():
+    # The defining property (paper Lemma 1): an arbitrarily-placed row moves
+    # the aggregate by at most ~iters * tau / n, NOT proportionally to its
+    # magnitude. Selection-free analog of test_byzantine_exclusion.
+    g = stack(9, 10, scale=0.1)
+    honest_out = np.asarray(gars["cclip"](np.ascontiguousarray(g), f=2))
+    radii = np.linalg.norm(
+        g - np.sort(g, axis=0)[4], axis=1
+    )
+    tau = np.median(radii)
+    for magnitude in (1e2, 1e6):
+        bad = g.copy()
+        bad[0] = magnitude
+        out = np.asarray(gars["cclip"](bad, f=2))
+        shift = np.linalg.norm(out - honest_out)
+        # Generous constant (tau-median jitter + 3 iterations), but
+        # magnitude-INdependent: the same bound must hold at 1e2 and 1e6.
+        assert shift <= 2.0 * tau + 1e-6, (magnitude, shift, tau)
+
+
+def test_cclip_nan_resilience():
+    g = stack(9, 12)
+    g[0] = np.nan
+    out = np.asarray(gars["cclip"](g, f=2))
+    assert np.all(np.isfinite(out))
+
+
+def test_cclip_permutation_invariance():
+    g = stack(9, 14)
+    perm = RNG.permutation(9)
+    a = np.asarray(gars["cclip"](g, f=2))
+    b = np.asarray(gars["cclip"](g[perm], f=2))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_cclip_tree_matches_flat():
+    # Tree-mode twin must agree with the flat path on a multi-leaf pytree.
+    import jax
+
+    leaves = {
+        "w": RNG.normal(size=(9, 4, 3)).astype(np.float32),
+        "b": RNG.normal(size=(9, 5)).astype(np.float32),
+    }
+    flat = np.concatenate(
+        [np.asarray(l).reshape(9, -1) for l in jax.tree.leaves(leaves)],
+        axis=1,
+    )
+    tree_out = gars["cclip"].tree_aggregate(
+        jax.tree.map(jnp.asarray, leaves), f=2
+    )
+    flat_from_tree = np.concatenate(
+        [np.asarray(l).reshape(-1) for l in jax.tree.leaves(tree_out)]
+    )
+    flat_out = np.asarray(gars["cclip"](flat, f=2))
+    np.testing.assert_allclose(flat_from_tree, flat_out, rtol=1e-5, atol=1e-6)
+
+
+def test_cclip_checked_contract():
+    with pytest.raises(AssertionError):
+        gars["cclip"].checked(stack(5, 4), f=3)  # needs n >= 2f+1 = 7
+    assert gars["cclip"].check(stack(7, 4), f=3) is None

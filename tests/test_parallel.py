@@ -69,6 +69,7 @@ class TestAggregathor:
         ("krum", "reverse", 2, 8),
         ("average", "empire", 2, None),
         ("average", None, 0, None),
+        ("cclip", "lie", 2, None),
     ])
     def test_tree_path_matches_flat_path(self, gar, attack, f, subset):
         """The tree-mode fast path (no flat (n, d) stack) must produce the
@@ -196,6 +197,64 @@ class TestAggregathor:
         assert runs[jnp.bfloat16][-1] < runs[jnp.bfloat16][0] * 0.8
         # Same task, same seeds: end-of-run losses agree to bf16-ish slack.
         assert abs(runs[None][-1] - runs[jnp.bfloat16][-1]) < 0.15
+
+    def test_worker_momentum_beta0_matches_baseline(self):
+        """beta = 0 degenerates to the raw-gradient pipeline: identical
+        trajectories (the momentum stack is write-through)."""
+        module, loss, opt = _pima_setup()
+        x, y = _pima_batches(8, 16)
+        runs = []
+        for wm in (None, 0.0):
+            init_fn, step_fn, _ = aggregathor.make_trainer(
+                module, loss, opt, "krum", num_workers=8, f=2, attack="lie",
+                worker_momentum=wm,
+            )
+            state = init_fn(jax.random.PRNGKey(0), x[0])
+            state, losses = _run(step_fn, state, x, y, 6)
+            runs.append(losses)
+        np.testing.assert_allclose(runs[0], runs[1], rtol=1e-5)
+
+    def test_worker_momentum_cclip_converges_under_lie(self):
+        """The Karimireddy et al. pairing (worker momentum + cclip) trains
+        through the lie attack; momentum state stays finite and updated."""
+        module, loss, opt = _pima_setup()
+        x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = aggregathor.make_trainer(
+            module, loss, opt, "cclip", num_workers=8, f=2, attack="lie",
+            worker_momentum=0.9,
+        )
+        state = init_fn(jax.random.PRNGKey(0), x[0])
+        state, losses = _run(step_fn, state, x, y, 40)
+        assert losses[-1] < losses[0] * 0.7
+        mom_leaves = jax.tree.leaves(jax.device_get(state.worker_mom))
+        assert mom_leaves, "momentum stack missing from TrainState"
+        for leaf in mom_leaves:
+            assert leaf.shape[0] == 8
+            assert np.isfinite(leaf).all()
+            assert np.abs(leaf).sum() > 0  # actually written
+
+    def test_worker_momentum_checkpoint_roundtrip(self, tmp_path):
+        """worker_mom travels through orbax save/restore like the rest of
+        the state (template-based restore, utils/checkpoint.py)."""
+        from garfield_tpu.utils import checkpoint as ckpt_lib
+
+        module, loss, opt = _pima_setup()
+        x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = aggregathor.make_trainer(
+            module, loss, opt, "cclip", num_workers=8, f=2, attack="lie",
+            worker_momentum=0.9,
+        )
+        state = init_fn(jax.random.PRNGKey(0), x[0])
+        state, _ = _run(step_fn, state, x, y, 3)
+        ckpt_lib.save(str(tmp_path), 3, state)
+        restored = ckpt_lib.restore(str(tmp_path), state)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            jax.device_get(state.worker_mom),
+            jax.device_get(restored.worker_mom),
+        )
 
     def test_accuracy_eval(self):
         module, loss, opt = _pima_setup()
