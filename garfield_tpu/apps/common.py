@@ -83,11 +83,17 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
       help="Aggregation-pipeline dtype: bfloat16 halves the HBM traffic of "
            "the attack+gather+GAR phase (Gram still accumulates in f32); "
            "default: full width.")
+    a("--gar_params", type=json.loads, default={},
+      help='Rule hyperparameters as JSON passed through to the GAR, e.g. '
+           '\'{"tau": 10.0}\' (cclip) or \'{"p": 0.5}\' (condense).')
     a("--worker_momentum", type=float, default=None,
       help="Worker-momentum beta in [0, 1): workers submit EMA momenta "
            "instead of raw gradients (Karimireddy et al. 2021) — pairs "
            "with --gar cclip to survive the lie attack that defeats "
-           "krum/bulyan (BASELINE.md TTA grid). Default: off.")
+           "krum/bulyan (BASELINE.md TTA grid). Use a PLAIN-SGD server "
+           "with it (omit momentum from --opt_args and raise lr ~x1/"
+           "(1-beta)): the worker EMA is the momentum; stacking it on a "
+           "momentum server destabilizes training. Default: off.")
     a("--fault_crashes", type=json.loads, default=None,
       help='Host crash schedule as JSON {"host": step, ...}: from the given '
            "step on, that simulated host's worker slots feed zero gradients "
@@ -264,12 +270,11 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
         "byz_mask" if "byz_mask" in trainer_params
         else "byz_worker_mask"  # byzsgd naming
     )
-    if (getattr(args, "worker_momentum", None) is not None
-            and "worker_momentum" not in trainer_params):
-        tools.warning(
-            f"[{tag}] --worker_momentum is not supported by this topology; "
-            "ignored"
-        )
+    for flag in ("worker_momentum", "gar_params"):
+        if getattr(args, flag, None) and flag not in trainer_params:
+            tools.warning(
+                f"[{tag}] --{flag} is not supported by this topology; ignored"
+            )
 
     def build(step):
         kwargs = dict(make_trainer_kwargs)
@@ -281,6 +286,8 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
         if (getattr(args, "worker_momentum", None) is not None
                 and "worker_momentum" in trainer_params):
             kwargs["worker_momentum"] = args.worker_momentum
+        if getattr(args, "gar_params", None) and "gar_params" in trainer_params:
+            kwargs["gar_params"] = args.gar_params
         if sched is not None:
             kwargs["attack"] = "crash"
             kwargs[mask_key] = sched.byz_mask(step, num_slots)

@@ -82,7 +82,7 @@ def _tree_path_ok(tree_path, subset, num_slots, granularity, gar):
 
 def _attack_then_aggregate(
     flat_stack, byz_mask, atk_key, sub_key, gar_key, *, attack,
-    attack_params, gar, f, subset,
+    attack_params, gar, f, subset, gar_params,
 ):
     """Poison rows, optionally subsample (wait n-f), aggregate. Pure.
     ``gar_key`` seeds randomized rules (condense's Bernoulli mask)."""
@@ -93,7 +93,7 @@ def _attack_then_aggregate(
     if subset is not None and subset < n:
         sel = core.subset_indices(sub_key, n, subset)
         stack = stack[sel]
-    return gar.unchecked(stack, f=f, key=gar_key)
+    return gar.unchecked(stack, f=f, key=gar_key, **gar_params)
 
 
 def make_trainer(
@@ -114,6 +114,7 @@ def make_trainer(
     tree_path=True,
     gar_dtype=None,
     worker_momentum=None,
+    gar_params=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the SSMW topology.
 
@@ -150,6 +151,14 @@ def make_trainer(
     honest update — a real Byzantine worker submits whatever it wants
     regardless of its declared state.
 
+    Pair worker momentum with a PLAIN-SGD server (no heavy-ball momentum in
+    ``optimizer``), as the paper's algorithm does — the worker EMA *is* the
+    momentum. Stacking it on a momentum server double-smooths the update
+    (two poles at ~0.9) and destabilizes training: measured on the hardened
+    ResNet-18 task, fault-free accuracy stalls at chance with server
+    momentum 0.9 but trains normally with momentum 0 at the
+    gain-compensated lr (BASELINE.md TTA grid, the worker-momentum rows).
+
     ``step_fn(state, x, y) -> (state, metrics)`` expects ``x``/``y`` with a
     leading ``num_workers`` axis, sharded over ``axis``; it is jit'd with
     replicated state output, so calling it in a loop keeps everything
@@ -157,6 +166,7 @@ def make_trainer(
     """
     gar = _resolve_gar(gar)
     attack_params = dict(attack_params or {})
+    gar_params = dict(gar_params or {})
     if mesh is None:
         mesh = mesh_lib.make_mesh({axis: -1})
     if subset is not None and not (1 <= subset <= num_workers):
@@ -254,7 +264,7 @@ def make_trainer(
 
         agg_kwargs = dict(
             attack=attack, attack_params=attack_params, gar=gar, f=f,
-            subset=subset,
+            subset=subset, gar_params=gar_params,
         )
         if _tree_path_ok(tree_path, subset, num_workers, granularity, gar):
             # Tree-mode fast path: poison rows leaf-wise, aggregate without
@@ -264,7 +274,9 @@ def make_trainer(
             poisoned = apply_gradient_attack_tree(
                 attack, grads, byz_mask, key=atk_key, **attack_params
             )
-            aggr_tree = gar.tree_aggregate(poisoned, f=f, key=gar_key)
+            aggr_tree = gar.tree_aggregate(
+                poisoned, f=f, key=gar_key, **gar_params
+            )
         elif granularity == "layer":
             # Garfield_CC per-parameter aggregation: independent GAR (and
             # attack statistics) per tensor, like the reference's per-layer

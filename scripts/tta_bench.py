@@ -33,7 +33,8 @@ TARGETS = (0.5, 0.7, 0.9)
 
 
 def run_one(f, *, iters, eval_every, lr, gar=None, num_workers=9,
-            batch=25, attack="lie"):
+            batch=25, attack="lie", worker_momentum=None,
+            gar_params=None, opt_momentum=0.9):
     from garfield_tpu import data, models, parallel
     from garfield_tpu.parallel import aggregathor, mesh as mesh_lib
     from garfield_tpu.utils import selectors
@@ -43,7 +44,7 @@ def run_one(f, *, iters, eval_every, lr, gar=None, num_workers=9,
     module = models.select_model("resnet18", "cifar10", dtype=dtype)
     loss_fn = selectors.select_loss("cross-entropy")
     opt = selectors.select_optimizer(
-        "sgd", lr=lr, momentum=0.9, weight_decay=5e-4
+        "sgd", lr=lr, momentum=opt_momentum, weight_decay=5e-4
     )
     if gar is None:
         gar = "krum" if f else "average"
@@ -52,6 +53,7 @@ def run_one(f, *, iters, eval_every, lr, gar=None, num_workers=9,
     init_fn, step_fn, eval_fn = aggregathor.make_trainer(
         module, loss_fn, opt, gar,
         num_workers=num_workers, f=f, attack=attack, mesh=mesh,
+        worker_momentum=worker_momentum, gar_params=gar_params,
     )
 
     manager = data.DatasetManager("cifar10", batch, num_workers, num_workers, 0)
@@ -82,6 +84,10 @@ def run_one(f, *, iters, eval_every, lr, gar=None, num_workers=9,
         tta[str(tgt)] = None if hit is None else hit["wall_s"]
     return {"f": f, "gar": gar, "attack": attack,
             "num_workers": num_workers, "batch": batch,
+            "worker_momentum": worker_momentum,
+            "gar_params": gar_params or None,
+            "opt_momentum": opt_momentum,
+            "lr": lr,
             "final_accuracy": curve[-1]["accuracy"] if curve else None,
             "time_to_target_s": tta, "curve": curve}
 
@@ -99,6 +105,15 @@ def main(argv=None):
                    help="Gradient attack for f > 0 rows (lie is the "
                         "literature's defense-breaking default; reverse/"
                         "random are the classic attacks robust rules beat).")
+    p.add_argument("--gar_params", type=json.loads, default=None,
+                   help="Rule hyperparameters as JSON (e.g. cclip tau).")
+    p.add_argument("--opt_momentum", type=float, default=0.9,
+                   help="Server SGD momentum (0 = plain SGD, the "
+                        "Karimireddy et al. server when workers carry "
+                        "momentum).")
+    p.add_argument("--worker_momentum", type=float, default=None,
+                   help="Worker-momentum beta (Karimireddy et al. 2021); "
+                        "pairs with --gar cclip.")
     p.add_argument("--lr", type=float, default=0.05,
                    help="SGD lr; the reference 0.2 makes krum-vs-lie at "
                    "f>=2 oscillate without converging on this task — "
@@ -117,11 +132,13 @@ def main(argv=None):
         results.append(run_one(
             f, iters=args.iters, eval_every=args.eval_every, lr=args.lr,
             gar=args.gar, num_workers=args.workers, attack=args.attack,
+            worker_momentum=args.worker_momentum,
+            gar_params=args.gar_params, opt_momentum=args.opt_momentum,
         ))
     artifact = {
-        "config": "resnet18/cifar10, batch 25/worker, SGD lr "
-                  f"{args.lr} m 0.9 wd 5e-4; rule/attack/worker-count are "
-                  "PER ROW (gar/attack/num_workers fields)",
+        "config": "resnet18/cifar10, batch 25/worker, SGD wd 5e-4; lr, "
+                  "server momentum (opt_momentum), rule/attack/worker-count/"
+                  "worker_momentum/gar_params are PER ROW",
         "data": "real cifar10 files" if real else
                 "deterministic synthetic surrogate (no dataset files; see "
                 "scripts/fetch_data.py)",
@@ -146,6 +163,9 @@ def main(argv=None):
             key = lambda r: (
                 r.get("f"), r.get("gar"), r.get("num_workers"),
                 r.get("attack", "lie" if r.get("f") else None),
+                r.get("worker_momentum"),
+                json.dumps(r.get("gar_params") or None, sort_keys=True),
+                r.get("opt_momentum", 0.9),
             )
             done = {key(r) for r in results}
             artifact["results"] = sorted(
@@ -169,8 +189,14 @@ def main(argv=None):
             "-" if tta[str(t)] is None else f"{tta[str(t)]:.1f}s"
             for t in TARGETS
         )
-        print(f"| {r['f']} (n={r['num_workers']}) | {r['gar']}"
-              f"{'+' + r['attack'] if r['attack'] else ''} | "
+        wm = r.get("worker_momentum")
+        cfg = r["gar"] + ("+" + r["attack"] if r["attack"] else "")
+        if wm is not None:
+            cfg += f"+wm{wm:g}"
+            cfg += f"/srv_m{r.get('opt_momentum', 0.9):g}"
+        if r.get("gar_params"):
+            cfg += f" {r['gar_params']}"
+        print(f"| {r['f']} (n={r['num_workers']}) | {cfg} | "
               f"{r['final_accuracy']:.4f} | {cells} |")
     return artifact
 
