@@ -61,7 +61,7 @@ def _clip_step(stack, center, tau, eps):
     )
 
 
-def aggregate(gradients, f, key=None, center=None, tau=None,
+def aggregate(gradients, f=0, key=None, center=None, tau=None,
               iters=ITERS, **kwargs):
     """Centered clipping around a robust center (see module docstring)."""
     stack = as_stack(gradients)
@@ -75,7 +75,7 @@ def aggregate(gradients, f, key=None, center=None, tau=None,
     return center
 
 
-def tree_aggregate(stacked_tree, f, key=None, center=None, tau=None,
+def tree_aggregate(stacked_tree, f=0, key=None, center=None, tau=None,
                    iters=ITERS, **kwargs):
     """Tree-mode twin: same math, no (n, d) flat stack.
 
@@ -122,7 +122,7 @@ def tree_aggregate(stacked_tree, f, key=None, center=None, tau=None,
     return jax.tree.unflatten(treedef, c_leaves)
 
 
-def check(gradients, f, **kwargs):
+def check(gradients, f=0, **kwargs):
     n = num_gradients(gradients)
     if n < 1:
         return f"expected at least one gradient to aggregate, got {gradients!r}"

@@ -42,6 +42,7 @@ def max_f(rule, n):
         "median": (n - 1) // 2,
         "tmean": (n - 1) // 2,
         "average": (n - 1) // 2,
+        "cclip": (n - 1) // 2,
     }
     base = rule.split("native-")[-1]
     return max(bounds.get(base, 0), 0)

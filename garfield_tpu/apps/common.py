@@ -271,7 +271,8 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
         else "byz_worker_mask"  # byzsgd naming
     )
     for flag in ("worker_momentum", "gar_params"):
-        if getattr(args, flag, None) and flag not in trainer_params:
+        set_ = getattr(args, flag, None)
+        if set_ is not None and set_ != {} and flag not in trainer_params:
             tools.warning(
                 f"[{tag}] --{flag} is not supported by this topology; ignored"
             )

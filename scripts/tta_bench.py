@@ -166,13 +166,19 @@ def main(argv=None):
                 r.get("worker_momentum"),
                 json.dumps(r.get("gar_params") or None, sort_keys=True),
                 r.get("opt_momentum", 0.9),
+                # lr is evidence, not tuning state: a re-run at a different
+                # lr must ADD a row, never silently replace the published
+                # measurement (rows predating the field were all lr 0.05).
+                r.get("lr", 0.05),
             )
-            done = {key(r) for r in results}
+            seen = {key(r) for r in results}
+            merged = list(results)
+            for r in prior.get("results", []):
+                if key(r) not in seen:  # also dedups prior-vs-prior
+                    seen.add(key(r))
+                    merged.append(r)
             artifact["results"] = sorted(
-                results + [
-                    r for r in prior.get("results", [])
-                    if key(r) not in done
-                ],
+                merged,
                 key=lambda r: (r.get("f", 0), str(r.get("gar")),
                                r.get("num_workers", 0)),
             )
@@ -190,7 +196,8 @@ def main(argv=None):
             for t in TARGETS
         )
         wm = r.get("worker_momentum")
-        cfg = r["gar"] + ("+" + r["attack"] if r["attack"] else "")
+        attack = r.get("attack", "lie" if r.get("f") else None)
+        cfg = r["gar"] + ("+" + attack if attack else "")
         if wm is not None:
             cfg += f"+wm{wm:g}"
             cfg += f"/srv_m{r.get('opt_momentum', 0.9):g}"
