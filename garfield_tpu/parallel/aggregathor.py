@@ -196,15 +196,7 @@ def make_trainer(
         opt_state = optimizer.init(params)
         worker_mom = None
         if worker_momentum is not None:
-            # Momentum lives at the aggregation pipeline's width: it is what
-            # workers exchange, so it shares gar_dtype with the gathered
-            # gradients (bf16 on the TPU bench path).
-            worker_mom = jax.tree.map(
-                lambda p: jnp.zeros(
-                    (num_workers,) + p.shape, gar_dtype or p.dtype
-                ),
-                params,
-            )
+            worker_mom = core.worker_mom_init(params, num_workers, gar_dtype)
         state = core.TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -251,11 +243,8 @@ def make_trainer(
         # the honest update is stored, the attack poisons its rows after.
         new_mom = state.worker_mom
         if worker_momentum is not None:
-            beta = jnp.asarray(worker_momentum, jnp.float32)
-            grads = jax.tree.map(
-                lambda m, g: ((1.0 - beta) * g.astype(jnp.float32)
-                              + beta * m.astype(jnp.float32)).astype(g.dtype),
-                state.worker_mom, grads,
+            grads = core.worker_mom_update(
+                worker_momentum, state.worker_mom, grads
             )
             new_mom = grads
 

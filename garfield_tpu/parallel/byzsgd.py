@@ -25,6 +25,13 @@ Honest-PS divergence (the reason model aggregation exists at all) arises here
 from per-PS wait-n-f subsets — each PS samples its *own* q of n gradients,
 mirroring different arrival orders at different servers in the async
 reference.
+
+``worker_momentum`` (aggregathor/learn) is deliberately NOT offered here:
+in this topology every PS slot evaluates the workers' batches against its
+OWN model replica, so a per-worker gradient EMA would need one momentum per
+(ps, worker) pair — semantics no deployed worker has (a real worker holds
+one momentum for the one model it pulls). Run the momentum defense on the
+SSMW or LEARN topologies, which match the paper's setting.
 """
 
 import functools

@@ -203,6 +203,27 @@ def cast_like(tree, ref_tree):
     return jax.tree.map(lambda a, p: a.astype(p.dtype), tree, ref_tree)
 
 
+def worker_mom_init(params, num_slots, dtype=None):
+    """Zeros momentum stack for ``worker_momentum`` topologies: one leading
+    slot axis per leaf, at the aggregation pipeline's width (``gar_dtype``
+    when narrowed — momentum is what workers exchange)."""
+    return jax.tree.map(
+        lambda p: jnp.zeros((num_slots,) + p.shape, dtype or p.dtype), params
+    )
+
+
+def worker_mom_update(beta, mom_tree, grads_tree):
+    """EMA ``(1-beta) g + beta m`` per leaf, accumulated in f32 and cast
+    back to the pipeline dtype (bf16 leaves would otherwise round the
+    small ``(1-beta) g`` increments away)."""
+    b = jnp.asarray(beta, jnp.float32)
+    return jax.tree.map(
+        lambda m, g: ((1.0 - b) * g.astype(jnp.float32)
+                      + b * m.astype(jnp.float32)).astype(g.dtype),
+        mom_tree, grads_tree,
+    )
+
+
 def subset_indices(key, n, q):
     """Uniformly sample q of n row indices (static shape (q,)).
 

@@ -72,6 +72,8 @@ def make_trainer(
     subset=None,
     track_spread=False,
     gar_dtype=None,
+    worker_momentum=None,
+    gar_params=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the LEARN topology.
 
@@ -91,11 +93,19 @@ def make_trainer(
     epilogue; gathers, attacks, aggregation and agreement rounds run at
     the narrow width; cast back at the optimizer boundary) — aggregathor's
     flag, applied to LEARN's phases 2-4. Model gossip stays full width.
+    ``worker_momentum`` (beta in [0, 1)): each node publishes the EMA of
+    its OWN gradients instead of the raw gradient — the decentralized form
+    of Karimireddy et al. 2021 (their ClippedGossip follow-up pairs exactly
+    this with clipped aggregation; use ``gar="cclip"``). The per-node
+    momentum stack lives in ``TrainState.worker_mom``, sharded over the
+    nodes axis with the rest of the node state. Pair with a plain-SGD
+    optimizer (see aggregathor.make_trainer — the EMA is the momentum).
     ``step_fn(state, x, y)``: leading ``num_nodes`` axis on x/y and on every
     params/opt_state leaf, all sharded over ``axis``.
     """
     gar = _resolve_gar(gar)
     attack_params = dict(attack_params or {})
+    gar_params = dict(gar_params or {})
     model_attack_params = dict(model_attack_params or {})
     if mesh is None:
         mesh = mesh_lib.make_mesh({axis: -1})
@@ -105,6 +115,10 @@ def make_trainer(
     # The GAR sees `subset` rows when waiting (reference passes the n-f
     # received gradients straight to the rule, LEARN/trainer.py:241).
     _check_gar(gar, subset if subset else num_nodes, f)
+    if worker_momentum is not None and not (0.0 <= worker_momentum < 1.0):
+        raise ValueError(
+            f"worker_momentum must be in [0, 1), got {worker_momentum}"
+        )
     if byz_mask is None:
         byz_mask = core.default_byz_mask(
             num_nodes, f if (attack or model_attack) else 0
@@ -121,12 +135,19 @@ def make_trainer(
         stack = lambda tree: jax.tree.map(
             lambda l: jnp.broadcast_to(l[None], (num_nodes,) + l.shape), tree
         )
+        worker_mom = None
+        if worker_momentum is not None:
+            worker_mom = jax.device_put(
+                core.worker_mom_init(params, num_nodes, gar_dtype),
+                node_sharding,
+            )
         return core.TrainState(
             step=jax.device_put(jnp.zeros((), jnp.int32), repl),
             params=jax.device_put(stack(params), node_sharding),
             model_state=jax.device_put(model_state, repl),
             opt_state=jax.device_put(stack(opt_state), node_sharding),
             rng=jax.device_put(key if seed_rng is None else seed_rng, repl),
+            worker_mom=worker_mom,
         )
 
     waiting = subset is not None and subset < num_nodes
@@ -146,7 +167,7 @@ def make_trainer(
             if waiting:
                 sel = core.subset_indices(sel_key, stack.shape[0], subset)
                 stack = stack[sel]
-            return gar.unchecked(stack, f=f, key=gkey)
+            return gar.unchecked(stack, f=f, key=gkey, **gar_params)
 
         def local_aggregates(stack, key):
             """All of this shard's node slots aggregate the same gathered
@@ -158,7 +179,7 @@ def make_trainer(
                     lambda nid: node_aggregate(stack, key, nid)
                 )(node_ids)
             # Full participation: one aggregate, identical for every node.
-            one = gar.unchecked(stack, f=f, key=key)
+            one = gar.unchecked(stack, f=f, key=key, **gar_params)
             return jnp.broadcast_to(one[None], (per_n,) + one.shape)
 
         def honest_spread(aggr_local):
@@ -189,6 +210,16 @@ def make_trainer(
         grads_local = jax.tree.map(lambda *ls: jnp.stack(ls), *grads)
         losses = jnp.stack(losses)
         grads_local = core.cast_leaves(grads_local, gar_dtype)
+
+        # Per-node momentum (see make_trainer docstring): each node
+        # publishes its EMA; the honest update is stored (sharded with the
+        # node state), Byzantine rows are re-poisoned after the gather.
+        new_mom = state.worker_mom
+        if worker_momentum is not None:
+            grads_local = core.worker_mom_update(
+                worker_momentum, state.worker_mom, grads_local
+            )
+            new_mom = grads_local
         new_ms = core.mean_model_state(
             jax.tree.map(lambda *ls: jnp.stack(ls), *ms_list), axis
         )
@@ -286,12 +317,14 @@ def make_trainer(
                 params=new_params,
                 model_state=new_ms,
                 opt_state=new_opt,
+                worker_mom=new_mom,
             ),
             {"loss": mean_loss, **metrics_extra},
         )
 
     state_specs = core.TrainState(
-        step=P(), params=P(axis), model_state=P(), opt_state=P(axis), rng=P()
+        step=P(), params=P(axis), model_state=P(), opt_state=P(axis), rng=P(),
+        worker_mom=(P(axis) if worker_momentum is not None else None),
     )
     sharded_step = jax.shard_map(
         _local_step,

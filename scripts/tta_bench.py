@@ -200,7 +200,10 @@ def main(argv=None):
         cfg = r["gar"] + ("+" + attack if attack else "")
         if wm is not None:
             cfg += f"+wm{wm:g}"
-            cfg += f"/srv_m{r.get('opt_momentum', 0.9):g}"
+        srv_m = r.get("opt_momentum", 0.9)
+        if wm is not None or srv_m != 0.9:
+            cfg += f"/srv_m{srv_m:g}"
+        cfg += f" lr{r.get('lr', 0.05):g}"
         if r.get("gar_params"):
             cfg += f" {r['gar_params']}"
         print(f"| {r['f']} (n={r['num_workers']}) | {cfg} | "
