@@ -52,12 +52,13 @@ class TrainState:
     model_state: dict
     opt_state: object
     rng: jax.Array
-    # Per-worker momentum stack (leading num_workers axis per leaf) when the
+    # Per-worker momentum stack (leading slot axis per leaf) when the
     # topology runs worker momentum (Karimireddy et al. 2021, the companion
-    # of the cclip GAR); None otherwise. Replicated like the rest of the
-    # state (aggregathor's shard_map passes the whole state at P()), so it
-    # costs num_workers x model HBM per device — budget accordingly on
-    # large models.
+    # of the cclip GAR); None otherwise. Sharded like the topology's node
+    # state: aggregathor passes the whole state at P() (replicated — the
+    # full num_workers x model stack costs HBM on EVERY device; budget
+    # accordingly on large models), LEARN shards the leading axis at
+    # P(axis) with params/opt_state.
     worker_mom: object = None
 
 

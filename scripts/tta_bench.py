@@ -34,9 +34,9 @@ TARGETS = (0.5, 0.7, 0.9)
 
 def run_one(f, *, iters, eval_every, lr, gar=None, num_workers=9,
             batch=25, attack="lie", worker_momentum=None,
-            gar_params=None, opt_momentum=0.9):
+            gar_params=None, opt_momentum=0.9, topology="aggregathor"):
     from garfield_tpu import data, models, parallel
-    from garfield_tpu.parallel import aggregathor, mesh as mesh_lib
+    from garfield_tpu.parallel import aggregathor, learn, mesh as mesh_lib
     from garfield_tpu.utils import selectors
 
     platform = jax.devices()[0].platform
@@ -49,12 +49,22 @@ def run_one(f, *, iters, eval_every, lr, gar=None, num_workers=9,
     if gar is None:
         gar = "krum" if f else "average"
     attack = attack if f else None
-    mesh = mesh_lib.make_mesh({"workers": 1}, devices=jax.devices()[:1])
-    init_fn, step_fn, eval_fn = aggregathor.make_trainer(
-        module, loss_fn, opt, gar,
-        num_workers=num_workers, f=f, attack=attack, mesh=mesh,
-        worker_momentum=worker_momentum, gar_params=gar_params,
-    )
+    if topology == "learn":
+        # Decentralized grid: every node worker+server on one chip; same
+        # n/batch/rule axes as the PS grid (ClippedGossip-style evidence).
+        mesh = mesh_lib.make_mesh({"nodes": 1}, devices=jax.devices()[:1])
+        init_fn, step_fn, eval_fn = learn.make_trainer(
+            module, loss_fn, opt, gar,
+            num_nodes=num_workers, f=f, attack=attack, mesh=mesh,
+            worker_momentum=worker_momentum, gar_params=gar_params,
+        )
+    else:
+        mesh = mesh_lib.make_mesh({"workers": 1}, devices=jax.devices()[:1])
+        init_fn, step_fn, eval_fn = aggregathor.make_trainer(
+            module, loss_fn, opt, gar,
+            num_workers=num_workers, f=f, attack=attack, mesh=mesh,
+            worker_momentum=worker_momentum, gar_params=gar_params,
+        )
 
     manager = data.DatasetManager("cifar10", batch, num_workers, num_workers, 0)
     manager.num_ps = 0
@@ -88,6 +98,7 @@ def run_one(f, *, iters, eval_every, lr, gar=None, num_workers=9,
             "gar_params": gar_params or None,
             "opt_momentum": opt_momentum,
             "lr": lr,
+            "topology": topology,
             "final_accuracy": curve[-1]["accuracy"] if curve else None,
             "time_to_target_s": tta, "curve": curve}
 
@@ -105,6 +116,10 @@ def main(argv=None):
                    help="Gradient attack for f > 0 rows (lie is the "
                         "literature's defense-breaking default; reverse/"
                         "random are the classic attacks robust rules beat).")
+    p.add_argument("--topology", choices=["aggregathor", "learn"],
+                   default="aggregathor",
+                   help="PS grid (default) or the decentralized LEARN grid "
+                        "(num_workers becomes num_nodes).")
     p.add_argument("--gar_params", type=json.loads, default=None,
                    help="Rule hyperparameters as JSON (e.g. cclip tau).")
     p.add_argument("--opt_momentum", type=float, default=0.9,
@@ -134,11 +149,12 @@ def main(argv=None):
             gar=args.gar, num_workers=args.workers, attack=args.attack,
             worker_momentum=args.worker_momentum,
             gar_params=args.gar_params, opt_momentum=args.opt_momentum,
+            topology=args.topology,
         ))
     artifact = {
         "config": "resnet18/cifar10, batch 25/worker, SGD wd 5e-4; lr, "
                   "server momentum (opt_momentum), rule/attack/worker-count/"
-                  "worker_momentum/gar_params are PER ROW",
+                  "worker_momentum/gar_params/topology are PER ROW",
         "data": "real cifar10 files" if real else
                 "deterministic synthetic surrogate (no dataset files; see "
                 "scripts/fetch_data.py)",
@@ -166,6 +182,7 @@ def main(argv=None):
                 r.get("worker_momentum"),
                 json.dumps(r.get("gar_params") or None, sort_keys=True),
                 r.get("opt_momentum", 0.9),
+                r.get("topology", "aggregathor"),
                 # lr is evidence, not tuning state: a re-run at a different
                 # lr must ADD a row, never silently replace the published
                 # measurement (rows predating the field were all lr 0.05).
@@ -198,6 +215,8 @@ def main(argv=None):
         wm = r.get("worker_momentum")
         attack = r.get("attack", "lie" if r.get("f") else None)
         cfg = r["gar"] + ("+" + attack if attack else "")
+        if r.get("topology", "aggregathor") != "aggregathor":
+            cfg = r["topology"] + ":" + cfg
         if wm is not None:
             cfg += f"+wm{wm:g}"
         srv_m = r.get("opt_momentum", 0.9)
