@@ -83,6 +83,9 @@ def _setup(args):
     n_w = len(cfg.workers)
     f = args.fw
     q = n_w - f
+    wm = getattr(args, "worker_momentum", None)
+    if wm is not None and not (0.0 <= wm < 1.0):
+        raise SystemExit(f"worker_momentum must be in [0, 1), got {wm}")
     if not f * 2 < n_w:
         # The majority-honest invariant the reference asserts
         # (Aggregathor/trainer.py:150-152) — enforced against the CONFIG's
@@ -152,9 +155,11 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
         test_batches, binary=args.dataset == "pima"
     )
 
+    gar_params = dict(getattr(args, "gar_params", None) or {})
+
     @jax.jit
     def ps_update(flat_params, opt_state, grads_stack):
-        agg = gar.unchecked(grads_stack, f=f) if f else jnp.mean(
+        agg = gar.unchecked(grads_stack, f=f, **gar_params) if f else jnp.mean(
             grads_stack, axis=0
         )
         params = unravel(flat_params)
@@ -181,9 +186,11 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
     # PS-side checkpoint/resume (utils/checkpoint.py — the deliberate
     # upgrade over the reference, which has none; the on-mesh analog with
     # sharded TrainState + bit-exact rng replay lives in common.train).
-    # Only the PS needs state: resumed workers request model round 0, and
-    # read_latest's catch-up semantics jump them straight to the PS's
-    # resumed round.
+    # Only the PS holds TRAINING state: resumed workers request model
+    # round 0 and read_latest's catch-up semantics jump them straight to
+    # the PS's resumed round. Exception: with --worker_momentum the workers
+    # hold the EMA, which is NOT persisted — it re-warms over ~1/(1-beta)
+    # steps after a resume (the worker warns; see _run_worker).
     ckpt = None
     start_iter = last_saved = 0
     if args.checkpoint_dir:
@@ -302,6 +309,20 @@ def _run_worker(args, windex, my_xs, my_ys, grad_fn, ms0, flat0, unravel,
     permanent casualty would silently consume the f budget.
     """
     attack = _host_attack(args.attack, args.attack_params)
+    # Worker momentum (Karimireddy et al. 2021; same EMA + zeros init as the
+    # on-mesh trainers, core.worker_mom_update): this process publishes its
+    # EMA instead of the raw gradient. A Byzantine worker poisons whatever
+    # it publishes (attack applied after), and a straggler that skips steps
+    # via read_latest only folds in gradients it actually computed — the
+    # real deployment semantics.
+    beta = getattr(args, "worker_momentum", None)
+    mom = None
+    if beta is not None and getattr(args, "resume", False):
+        tools.warning(
+            f"worker {windex}: worker momentum is not checkpointed — the "
+            f"EMA restarts from zero and re-warms over ~{1.0 / (1.0 - beta):.0f} "
+            "steps after this resume"
+        )
 
     @jax.jit
     def worker_grad(flat_params, ms, x, y, rng):
@@ -334,6 +355,9 @@ def _run_worker(args, windex, my_xs, my_ys, grad_fn, ms0, flat0, unravel,
             my_xs[b], my_ys[b], jax.random.fold_in(base_key, step),
         )
         g = np.asarray(g, np.float32)
+        if beta is not None:
+            mom = (1.0 - beta) * g + beta * (0.0 if mom is None else mom)
+            g = mom.astype(np.float32)
         if attack is not None:
             g = attack(g)
         ex.publish(step, g.tobytes(), to=[0])

@@ -84,6 +84,33 @@ def _launch(role, cfg_path, env, extra=()):
     )
 
 
+def _assert_ps_converges(ps, workers, tag):
+    """Shared tail of the convergence tests: PS exits 0 with all 60 steps,
+    accuracy improves over step 0, every worker exits 0; processes are
+    killed on any failure path."""
+    try:
+        out, _ = ps.communicate(timeout=400)
+        assert ps.returncode == 0, f"PS failed:\n{out[-2000:]}"
+        summary = json.loads(
+            [l for l in out.splitlines() if l.startswith("{")][-1]
+        )
+        assert summary["steps"] == 60
+        first_acc = float(
+            [l for l in out.splitlines() if l.startswith("Step: 0 ")][0]
+            .split()[3]
+        )
+        assert summary["final_accuracy"] > max(0.3, first_acc + 0.1), (
+            f"{tag}: {summary}"
+        )
+        for w in workers:
+            wout, _ = w.communicate(timeout=120)
+            assert w.returncode == 0, f"worker failed:\n{wout[-1500:]}"
+    finally:
+        for p in [ps, *workers]:
+            if p.poll() is None:
+                p.kill()
+
+
 def test_byzantine_worker_process_tolerated(tmp_path):
     """A REAL Byzantine process (not an on-mesh emulation): worker 3 runs
     with --attack reverse (publishes -100x its gradient, byzWorker.py
@@ -101,27 +128,35 @@ def test_byzantine_worker_process_tolerated(tmp_path):
         )
         for w in range(n_w)
     ]
-    try:
-        out, _ = ps.communicate(timeout=400)
-        assert ps.returncode == 0, f"PS failed:\n{out[-2000:]}"
-        summary = json.loads(
-            [l for l in out.splitlines() if l.startswith("{")][-1]
+    _assert_ps_converges(
+        ps, workers, "median did not ride out the Byzantine worker"
+    )
+
+
+def test_cluster_momentum_cclip_defense(tmp_path):
+    """The worker-momentum + cclip defense in the TRUE deployment shape:
+    every process publishes its gradient EMA (plain-SGD server, the
+    required pairing — BASELINE.md), the PS clips, and a real Byzantine
+    process attacking with reverse x(-100) cannot stop convergence."""
+    n_w = 4
+    cfg_path, env = _cluster_setup(tmp_path, n_w)
+    defense = (
+        "--gar", "cclip", "--worker_momentum", "0.9",
+        "--opt_args", '{"lr":"0.5"}',
+    )
+    ps = _launch("ps:0", cfg_path, env, extra=defense)
+    workers = [
+        _launch(
+            f"worker:{w}", cfg_path, env,
+            extra=defense + (
+                ("--attack", "reverse") if w == n_w - 1 else ()
+            ),
         )
-        assert summary["steps"] == 60
-        first_acc = float(
-            [l for l in out.splitlines() if l.startswith("Step: 0 ")][0]
-            .split()[3]
-        )
-        assert summary["final_accuracy"] > max(0.3, first_acc + 0.1), (
-            f"median did not ride out the Byzantine worker: {summary}"
-        )
-        for w in workers:
-            wout, _ = w.communicate(timeout=120)
-            assert w.returncode == 0, f"worker failed:\n{wout[-1500:]}"
-    finally:
-        for p in [ps, *workers]:
-            if p.poll() is None:
-                p.kill()
+        for w in range(n_w)
+    ]
+    _assert_ps_converges(
+        ps, workers, "cclip+momentum did not ride out the Byzantine worker"
+    )
 
 
 def test_ps_checkpoint_resume(tmp_path):

@@ -22,7 +22,8 @@ from garfield_tpu.attacks import apply_gradient_attack
 # n = 11 admits every rule's contract at f = 2 (bulyan needs n >= 4f+3).
 N, F, D = 11, 2, 64
 SIGMA = 0.01
-RULES = ["krum", "median", "bulyan", "brute", "aksel", "condense", "tmean"]
+RULES = ["krum", "median", "bulyan", "brute", "aksel", "condense", "tmean",
+         "cclip"]
 # reverse/empire shove the Byzantine rows far from the cluster; random
 # replaces them with unit-scale noise (moderate displacement); lie/drop are
 # designed to be subtle (stay within/near the honest spread).
