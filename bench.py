@@ -18,7 +18,7 @@ ResNet-18 estimate) / measured step time / the chip's peak bf16 FLOP/s.
 Env knobs: GARFIELD_BENCH_STEPS (timed steps, default 20),
 GARFIELD_BENCH_WORKERS, GARFIELD_BENCH_F, GARFIELD_BENCH_BATCH,
 GARFIELD_BENCH_ATTEMPTS (transient-failure retries, default 5),
-GARFIELD_BENCH_TRIALS (independent timed trials, default 3 — the shared
+GARFIELD_BENCH_TRIALS (independent timed trials, default 4 — the shared
 chip's run-to-run variance spikes 1.5-4x for stretches, so the reported
 value is the BEST trial: closest to the machine's actual capability and
 the standard guard against co-tenant noise),
@@ -172,7 +172,7 @@ def main():
     # fresh lower().compile(); the persistent cache makes that near-free when
     # the previous attempt got past compilation (and across driver re-runs).
     attempts = max(1, int(os.environ.get("GARFIELD_BENCH_ATTEMPTS", 5)))
-    trials = max(1, int(os.environ.get("GARFIELD_BENCH_TRIALS", 3)))
+    trials = max(1, int(os.environ.get("GARFIELD_BENCH_TRIALS", 4)))
     dt = compiled = None
     for trial in range(trials):
         trial_dt = None

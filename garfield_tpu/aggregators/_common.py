@@ -117,6 +117,19 @@ def tree_weighted_sum(grads_tree, w):
     return jax.tree.map(one, grads_tree)
 
 
+def tree_coordinatewise(fn, stacked_tree):
+    """Apply a coordinate-wise ``(n, d) -> (d,)`` reducer per LEAF of a
+    stacked gradient tree — the shared plumbing of the tree-mode twins
+    (median, tmean, cclip's center init): coordinate-wise rules decompose
+    per leaf, so the (n, d) flat stack never materializes (PERF.md:
+    21.3 -> 16.2 ms/step for the median aggregathor step on the chip)."""
+    leaves, treedef = jax.tree.flatten(stacked_tree)
+    n = leaves[0].shape[0]
+    return jax.tree.unflatten(treedef, [
+        fn(l.reshape(n, -1)).reshape(l.shape[1:]) for l in leaves
+    ])
+
+
 def coordinate_median(g):
     """Lower coordinate-wise median of a (n, d) stack -> (d,).
 

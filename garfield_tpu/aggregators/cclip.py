@@ -35,7 +35,9 @@ import jax
 import jax.numpy as jnp
 
 from . import register
-from ._common import as_stack, coordinate_median, num_gradients
+from ._common import (
+    as_stack, coordinate_median, num_gradients, tree_coordinatewise,
+)
 
 ITERS = 3  # fixed-point iterations (paper §4: 1-3 suffice)
 
@@ -86,10 +88,9 @@ def tree_aggregate(stacked_tree, f=0, key=None, center=None, tau=None,
     n = leaves[0].shape[0]
     eps = jnp.asarray(1e-12, jnp.float32)
     if center is None:
-        c_leaves = [
-            coordinate_median(l.reshape(n, -1)).reshape(l.shape[1:])
-            for l in leaves
-        ]
+        c_leaves = jax.tree.leaves(
+            tree_coordinatewise(coordinate_median, stacked_tree)
+        )
     else:
         c_leaves = jax.tree.leaves(center)
     for _ in range(iters):

@@ -10,13 +10,27 @@ kernel, median.cu).
 
 import math
 
+import jax
+
 from . import register
-from ._common import as_stack, coordinate_median, num_gradients
+from ._common import (
+    as_stack, coordinate_median, num_gradients, tree_coordinatewise,
+)
 
 
 def aggregate(gradients, **kwargs):
     """NaN-resilient coordinate-wise (lower) median."""
     return coordinate_median(as_stack(gradients))
+
+
+def tree_aggregate(stacked_tree, key=None, **kwargs):
+    """Tree-mode twin (r3): the median is coordinate-wise, so it decomposes
+    per leaf — the (n, d) flat stack (flatten + unflatten + its DUS
+    staging) is never built. Measured on the v5e chip: the 8-worker
+    ResNet-18 aggregathor step under lie drops 21.3 -> 16.2 ms/step
+    (PERF.md); the per-leaf Pallas launches cost less than the flat-stack
+    plumbing they replace."""
+    return tree_coordinatewise(coordinate_median, stacked_tree)
 
 
 def check(gradients, **kwargs):
@@ -30,4 +44,5 @@ def upper_bound(n, f, d):
     return 1 / math.sqrt(n - f)
 
 
-register("median", aggregate, check, upper_bound=upper_bound)
+register("median", aggregate, check, upper_bound=upper_bound,
+         tree_aggregate=tree_aggregate)

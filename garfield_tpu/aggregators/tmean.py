@@ -16,8 +16,10 @@ in the trimmed tail and do not contaminate the result.
 
 import math
 
+import jax
+
 from . import register
-from ._common import as_stack, num_gradients
+from ._common import as_stack, num_gradients, tree_coordinatewise
 
 
 def aggregate(gradients, f, **kwargs):
@@ -25,6 +27,14 @@ def aggregate(gradients, f, **kwargs):
     from .. import ops
 
     return ops.trimmed_mean(as_stack(gradients), f)
+
+
+def tree_aggregate(stacked_tree, f, key=None, **kwargs):
+    """Tree-mode twin (r3): coordinate-wise, so per-leaf like median's
+    (see median.tree_aggregate for the chip measurement)."""
+    from .. import ops
+
+    return tree_coordinatewise(lambda g: ops.trimmed_mean(g, f), stacked_tree)
 
 
 def check(gradients, f, **kwargs):
@@ -44,4 +54,5 @@ def upper_bound(n, f, d):
     return 1 / math.sqrt(n - f)
 
 
-register("tmean", aggregate, check, upper_bound=upper_bound)
+register("tmean", aggregate, check, upper_bound=upper_bound,
+         tree_aggregate=tree_aggregate)

@@ -159,9 +159,13 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
 
     @jax.jit
     def ps_update(flat_params, opt_state, grads_stack):
-        agg = gar.unchecked(grads_stack, f=f, **gar_params) if f else jnp.mean(
-            grads_stack, axis=0
-        )
+        # f=0 with the default rule short-circuits to the mean, but an
+        # explicitly requested rule (e.g. cclip, which is valid at f=0)
+        # must run — silently averaging would fake the defense.
+        if f or args.gar != "average":
+            agg = gar.unchecked(grads_stack, f=f, **gar_params)
+        else:
+            agg = jnp.mean(grads_stack, axis=0)
         params = unravel(flat_params)
         updates, opt_state = optimizer.update(
             unravel(agg), opt_state, params

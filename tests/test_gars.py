@@ -456,3 +456,31 @@ def test_cclip_checked_contract():
     with pytest.raises(AssertionError):
         gars["cclip"].checked(stack(5, 4), f=3)  # needs n >= 2f+1 = 7
     assert gars["cclip"].check(stack(7, 4), f=3) is None
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("median", {}),
+    ("tmean", {"f": 2}),
+])
+def test_coordinatewise_tree_matches_flat(name, kwargs):
+    """r3 tree-mode twins of the coordinate-wise rules decompose per leaf;
+    they must agree elementwise with the flat path."""
+    import jax
+
+    leaves = {
+        "w": RNG.normal(size=(9, 4, 3)).astype(np.float32),
+        "b": RNG.normal(size=(9, 5)).astype(np.float32),
+    }
+    flat = np.concatenate(
+        [np.asarray(l).reshape(9, -1) for l in jax.tree.leaves(leaves)],
+        axis=1,
+    )
+    tree_out = gars[name].tree_aggregate(
+        jax.tree.map(jnp.asarray, leaves), **kwargs
+    )
+    flat_from_tree = np.concatenate(
+        [np.asarray(l).reshape(-1) for l in jax.tree.leaves(tree_out)]
+    )
+    flat_out = np.asarray(gars[name](flat, **kwargs))
+    np.testing.assert_allclose(flat_from_tree, flat_out, rtol=1e-6,
+                               atol=1e-7)

@@ -70,6 +70,8 @@ class TestAggregathor:
         ("average", "empire", 2, None),
         ("average", None, 0, None),
         ("cclip", "lie", 2, None),
+        ("median", "lie", 2, None),
+        ("tmean", "reverse", 2, None),
     ])
     def test_tree_path_matches_flat_path(self, gar, attack, f, subset):
         """The tree-mode fast path (no flat (n, d) stack) must produce the
