@@ -10,8 +10,6 @@ kernel, median.cu).
 
 import math
 
-import jax
-
 from . import register
 from ._common import (
     as_stack, coordinate_median, num_gradients, tree_coordinatewise,

@@ -16,8 +16,6 @@ in the trimmed tail and do not contaminate the result.
 
 import math
 
-import jax
-
 from . import register
 from ._common import as_stack, num_gradients, tree_coordinatewise
 

@@ -157,13 +157,21 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
 
     gar_params = dict(getattr(args, "gar_params", None) or {})
 
+    gar_base_key = jax.random.PRNGKey(args.seed)
+
     @jax.jit
-    def ps_update(flat_params, opt_state, grads_stack):
+    def ps_update(flat_params, opt_state, grads_stack, step):
         # f=0 with the default rule short-circuits to the mean, but an
         # explicitly requested rule (e.g. cclip, which is valid at f=0)
-        # must run — silently averaging would fake the defense.
+        # must run — silently averaging would fake the defense. Randomized
+        # rules (condense) need a fresh per-step key: without it the fixed
+        # keyless fallback would apply the SAME coordinate mask every
+        # iteration under jit.
         if f or args.gar != "average":
-            agg = gar.unchecked(grads_stack, f=f, **gar_params)
+            agg = gar.unchecked(
+                grads_stack, f=f,
+                key=jax.random.fold_in(gar_base_key, step), **gar_params,
+            )
         else:
             agg = jnp.mean(grads_stack, axis=0)
         params = unravel(flat_params)
@@ -261,7 +269,8 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
             np.frombuffer(got[k], np.float32) for k in sorted(got)[:q]
         ]
         flat_dev, opt_state = ps_update(
-            flat_dev, opt_state, jnp.asarray(np.stack(rows))
+            flat_dev, opt_state, jnp.asarray(np.stack(rows)),
+            jnp.asarray(i, jnp.int32),
         )
         flat = np.asarray(flat_dev, np.float32)  # next step's publication
         losses_seen = i + 1

@@ -79,6 +79,8 @@ def make_trainer(
     granularity="model",
     tree_path=True,
     gar_dtype=None,
+    gar_params=None,
+    model_gar_params=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the MSMW topology.
 
@@ -93,7 +95,8 @@ def make_trainer(
     the whole flat vector.
 
     ``tree_path`` (default on): rules with tree-mode aggregation (average,
-    krum) run the gradient phase on the stacked gradient TREE — no
+    krum, cclip, and the per-leaf coordinate-wise twins of median/tmean)
+    run the gradient phase on the stacked gradient TREE — no
     (n_w, d) flat stack per PS slot (same win as aggregathor's tree path,
     PERF.md); the model gather phase always works on flat model vectors.
 
@@ -102,6 +105,10 @@ def make_trainer(
     optimizer boundary) exactly like aggregathor's flag; the model-space
     phase stays full width (models are parameters, not gradients).
 
+    ``gar_params`` passes rule hyperparameters (cclip tau/iters, condense
+    p) to the gradient rule; ``model_gar_params`` to the model-space rule
+    (default: same as ``gar_params``, matching the shared-rule default).
+
     ``step_fn(state, x, y)``: ``x``/``y`` lead with ``num_workers`` sharded
     over ``axis``; state params/opt_state lead with ``num_ps`` sharded over
     ``ps_axis``.
@@ -109,6 +116,12 @@ def make_trainer(
     gar = _resolve_gar(gar)
     model_gar = gar if model_gar is None else _resolve_gar(model_gar)
     attack_params = dict(attack_params or {})
+    gar_params = dict(gar_params or {})
+    # The model-space rule defaults to the gradient rule; its params follow
+    # the same convention unless overridden.
+    model_gar_params = dict(
+        gar_params if model_gar_params is None else model_gar_params
+    )
     ps_attack_params = dict(ps_attack_params or {})
     if mesh is None:
         mesh = mesh_lib.make_mesh({ps_axis: 1, axis: -1})
@@ -172,13 +185,13 @@ def make_trainer(
         if granularity == "layer":
             aggr = core.segmented_aggregate(
                 lambda s, i: gar.unchecked(
-                    s, f=fw, key=jax.random.fold_in(gkey, i)
+                    s, f=fw, key=jax.random.fold_in(gkey, i), **gar_params
                 ),
                 stack,
                 core.leaf_segments(params),
             )
         else:
-            aggr = gar.unchecked(stack, f=fw, key=gkey)
+            aggr = gar.unchecked(stack, f=fw, key=gkey, **gar_params)
         aggr_tree = core.unflatten_like(params, aggr)
         aggr_tree = core.cast_like(aggr_tree, params)  # no-op at f32
         updates, new_opt = optimizer.update(aggr_tree, opt_state, params)
@@ -243,6 +256,7 @@ def make_trainer(
                 aggr_tree = gar.tree_aggregate(
                     poisoned, f=fw,
                     key=jax.random.fold_in(gar_key, ps_ids[k]),
+                    **gar_params,
                 )
                 p_k = jax.tree.map(lambda l: l[k], state.params)
                 o_k = jax.tree.map(lambda l: l[k], state.opt_state)
@@ -281,13 +295,16 @@ def make_trainer(
         if granularity == "layer":
             aggr_model = core.segmented_aggregate(
                 lambda s, i: model_gar.unchecked(
-                    s, f=fps, key=jax.random.fold_in(mgar_key, i)
+                    s, f=fps, key=jax.random.fold_in(mgar_key, i),
+                    **model_gar_params,
                 ),
                 models,
                 core.leaf_segments(params0),
             )
         else:
-            aggr_model = model_gar.unchecked(models, f=fps, key=mgar_key)
+            aggr_model = model_gar.unchecked(
+                models, f=fps, key=mgar_key, **model_gar_params
+            )
         written = core.unflatten_like(params0, aggr_model)
         new_params = jax.tree.map(
             lambda l: jnp.broadcast_to(l[None], (per_ps,) + l.shape), written

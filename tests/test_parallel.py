@@ -235,6 +235,21 @@ class TestAggregathor:
             assert np.isfinite(leaf).all()
             assert np.abs(leaf).sum() > 0  # actually written
 
+    def test_worker_momentum_with_wait_nf_subset(self):
+        """Momentum composes with the wait-n-f path: the EMA updates on
+        every worker before the gather, the subset samples rows after the
+        attack — training proceeds and stays finite."""
+        module, loss, opt = _pima_setup()
+        x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = aggregathor.make_trainer(
+            module, loss, opt, "cclip", num_workers=8, f=1, attack="lie",
+            subset=7, worker_momentum=0.9,
+        )
+        state = init_fn(jax.random.PRNGKey(0), x[0])
+        state, losses = _run(step_fn, state, x, y, 30)
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0] * 0.8
+
     def test_worker_momentum_checkpoint_roundtrip(self, tmp_path):
         """worker_mom travels through orbax save/restore like the rest of
         the state (template-based restore, utils/checkpoint.py)."""
