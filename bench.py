@@ -233,7 +233,7 @@ def main():
             )
     except OSError:
         pass
-    vs = steps_per_sec_per_chip / baseline if baseline else 1.0
+    vs = steps_per_sec_per_chip / baseline if baseline else None
     # One format string for every config: the official north-star name
     # ("...w8_f2_krum_lie") falls out of the defaults. vs_baseline is only
     # meaningful against the published krum/lie batch-25 record, so any
@@ -247,6 +247,7 @@ def main():
         (gar_name, attack_name, num_workers, f, batch)
         == ("krum", "lie", 8, 2, 25)
         and not os.environ.get("GARFIELD_BENCH_F32_GAR")
+        and platform == "tpu"  # CPU fallback runs f32 — not the record's config
     )
     if not official:
         vs = None

@@ -184,3 +184,39 @@ def test_fault_crash_learn_model_gossip():
     import numpy as np
 
     assert np.isfinite(summary["final_loss"])
+
+
+def test_bench_driver_artifact_smoke():
+    """bench.py is the driver's official perf artifact (BENCH_r02 was lost
+    to an unhandled transient once — VERDICT r2 #1): it must run end to end
+    and print exactly one valid JSON line on stdout whatever the knobs.
+    Tiny config on the CPU backend; the off-default knobs must also report
+    vs_baseline null (not a krum-vs-average apples-to-oranges ratio)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # stay off the TPU tunnel
+    env.update(
+        JAX_PLATFORMS="cpu",
+        GARFIELD_BENCH_STEPS="2",
+        GARFIELD_BENCH_TRIALS="1",
+        GARFIELD_BENCH_WORKERS="4",
+        GARFIELD_BENCH_F="1",
+        GARFIELD_BENCH_GAR="median",
+        GARFIELD_BENCH_ATTACK="lie",
+        GARFIELD_BENCH_BATCH="2",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    out = json.loads(lines[0])
+    assert out["value"] > 0
+    assert out["unit"] == "steps/s/chip"
+    assert out["metric"].endswith("w4_f1_median_lie")
+    assert out["vs_baseline"] is None  # off-default config: no ratchet ratio
