@@ -35,7 +35,7 @@ _PAGE = """<!doctype html>
 <form onsubmit="start(event)">
   nodes <input id=n value=8 size=2>
   f <input id=f value=1 size=2>
-  gar <select id=g><option>median<option>krum<option>average<option>aksel
+  gar <select id=g><option>median<option>krum<option>average<option>aksel<option>cclip<option>tmean
       </select>
   attack <select id=a><option>none<option>lie<option>random<option>reverse
       <option>empire<option>drop</select>
