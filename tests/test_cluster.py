@@ -84,17 +84,17 @@ def _launch(role, cfg_path, env, extra=()):
     )
 
 
-def _assert_ps_converges(ps, workers, tag):
-    """Shared tail of the convergence tests: PS exits 0 with all 60 steps,
+def _assert_ps_converges(ps, workers, tag, steps=60, timeout=400):
+    """Shared tail of the convergence tests: PS exits 0 with all steps done,
     accuracy improves over step 0, every worker exits 0; processes are
     killed on any failure path."""
     try:
-        out, _ = ps.communicate(timeout=400)
+        out, _ = ps.communicate(timeout=timeout)
         assert ps.returncode == 0, f"PS failed:\n{out[-2000:]}"
         summary = json.loads(
             [l for l in out.splitlines() if l.startswith("{")][-1]
         )
-        assert summary["steps"] == 60
+        assert summary["steps"] == steps
         first_acc = float(
             [l for l in out.splitlines() if l.startswith("Step: 0 ")][0]
             .split()[3]
@@ -120,16 +120,23 @@ def test_byzantine_worker_process_tolerated(tmp_path):
     timeout-bounded.)"""
     n_w = 4
     cfg_path, env = _cluster_setup(tmp_path, n_w)
-    ps = _launch("ps:0", cfg_path, env)
+    # 120 iters (vs 60 elsewhere): the PS quorum is the 3 FASTEST of 4, so
+    # under full-suite CPU contention the Byzantine worker lands in the
+    # quorum more often than in an isolated run — convergence still holds
+    # (median of 3 with 1 byz row is bounded by the honest pair) but needs
+    # more steps of headroom to clear the accuracy bar deterministically.
+    ps = _launch("ps:0", cfg_path, env, extra=("--num_iter", "120"))
     workers = [
         _launch(
             f"worker:{w}", cfg_path, env,
-            extra=("--attack", "reverse") if w == n_w - 1 else (),
+            extra=(("--num_iter", "120")
+                   + (("--attack", "reverse") if w == n_w - 1 else ())),
         )
         for w in range(n_w)
     ]
     _assert_ps_converges(
-        ps, workers, "median did not ride out the Byzantine worker"
+        ps, workers, "median did not ride out the Byzantine worker",
+        steps=120, timeout=800,  # proportional headroom for 2x the steps
     )
 
 
