@@ -125,18 +125,19 @@ def test_byzantine_worker_process_tolerated(tmp_path):
     # quorum more often than in an isolated run — convergence still holds
     # (median of 3 with 1 byz row is bounded by the honest pair) but needs
     # more steps of headroom to clear the accuracy bar deterministically.
-    ps = _launch("ps:0", cfg_path, env, extra=("--num_iter", "120"))
+    n_iter = 120
+    ps = _launch("ps:0", cfg_path, env, extra=("--num_iter", str(n_iter)))
     workers = [
         _launch(
             f"worker:{w}", cfg_path, env,
-            extra=(("--num_iter", "120")
+            extra=(("--num_iter", str(n_iter))
                    + (("--attack", "reverse") if w == n_w - 1 else ())),
         )
         for w in range(n_w)
     ]
     _assert_ps_converges(
         ps, workers, "median did not ride out the Byzantine worker",
-        steps=120, timeout=800,  # proportional headroom for 2x the steps
+        steps=n_iter, timeout=400 + 5 * n_iter,
     )
 
 
@@ -147,9 +148,17 @@ def test_cluster_momentum_cclip_defense(tmp_path):
     process attacking with reverse x(-100) cannot stop convergence."""
     n_w = 4
     cfg_path, env = _cluster_setup(tmp_path, n_w)
+    # lr 0.2 is the TTA-proven stable pairing for wm 0.9 on a plain-SGD
+    # server (BASELINE.md: lr 0.5 climbs then COLLAPSES late — the worker
+    # EMA's lag destabilizes the hot step; this test first sampled before
+    # the collapse and flaked). The effective rate is 5x below the median
+    # twin's (which runs a momentum server), and the PS proceeds with the
+    # q = 3 fastest workers while subprocess startup staggers by tens of
+    # seconds on this 1-core box — so give the surviving quorum 400 steps.
+    n_iter = 400
     defense = (
         "--gar", "cclip", "--worker_momentum", "0.9",
-        "--opt_args", '{"lr":"0.5"}',
+        "--opt_args", '{"lr":"0.2"}', "--num_iter", str(n_iter),
     )
     ps = _launch("ps:0", cfg_path, env, extra=defense)
     workers = [
@@ -162,7 +171,8 @@ def test_cluster_momentum_cclip_defense(tmp_path):
         for w in range(n_w)
     ]
     _assert_ps_converges(
-        ps, workers, "cclip+momentum did not ride out the Byzantine worker"
+        ps, workers, "cclip+momentum did not ride out the Byzantine worker",
+        steps=n_iter, timeout=400 + 5 * n_iter,
     )
 
 
