@@ -33,7 +33,7 @@ class GAR:
     """
 
     def __init__(self, name, unchecked, check, upper_bound=None, influence=None,
-                 tree_aggregate=None):
+                 tree_aggregate=None, gram_select=None):
         self.name = name
         self.unchecked = unchecked
         self.check = check
@@ -45,6 +45,14 @@ class GAR:
         # aggregathor.py for the dispatch and PERF.md for the measured
         # wins (flat stack ~5 ms/step; median step 21.3 -> 16.2 ms).
         self.tree_aggregate = tree_aggregate
+        # Optional Gram-form selection: ``gram_select(gram, f, **params) ->
+        # (n,) weights`` such that the aggregate equals ``w @ stack``. Rules
+        # exposing it (krum, average) get the folded attack application
+        # (attacks.plan_gradient_attack_fold / parallel.fold): deterministic
+        # attacks become a static remap+scale of the Gram, the poisoned rows
+        # are never written, and the raw Gram keeps fusing into the
+        # backward epilogue (PERF.md round 4: 1.16x on krum+lie).
+        self.gram_select = gram_select
 
         def checked(gradients, *args, **kwargs):
             message = check(gradients, *args, **kwargs)
@@ -70,12 +78,13 @@ gars = {}
 
 
 def register(name, unchecked, check, upper_bound=None, influence=None,
-             tree_aggregate=None):
+             tree_aggregate=None, gram_select=None):
     """Register an aggregation rule (reference __init__.py:71-86)."""
     if name in gars:
         tools.warning(f"GAR {name!r} already registered; overwriting")
     gar = GAR(name, unchecked, check, upper_bound=upper_bound,
-              influence=influence, tree_aggregate=tree_aggregate)
+              influence=influence, tree_aggregate=tree_aggregate,
+              gram_select=gram_select)
     gars[name] = gar
     return gar
 

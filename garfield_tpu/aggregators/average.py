@@ -21,6 +21,13 @@ def tree_aggregate(grads_tree, **kwargs):
     return jax.tree.map(lambda l: jnp.mean(l, axis=0), grads_tree)
 
 
+def gram_select(gram, f=0, **kwargs):
+    """Uniform weights (the Gram is unused and DCE'd by XLA) — lets the
+    folded attack path (parallel.fold) serve the average baseline too."""
+    n = gram.shape[0]
+    return jnp.full((n,), 1.0 / n, jnp.float32)
+
+
 def check(gradients, **kwargs):
     if num_gradients(gradients) < 1:
         return f"expected at least one gradient to aggregate, got {gradients!r}"
@@ -33,4 +40,4 @@ def influence(honests, attacks, **kwargs):
 
 
 register("average", aggregate, check, influence=influence,
-         tree_aggregate=tree_aggregate)
+         tree_aggregate=tree_aggregate, gram_select=gram_select)

@@ -95,6 +95,16 @@ def tree_aggregate(grads_tree, f, m=None, **kwargs):
     return tree_weighted_sum(grads_tree, w)
 
 
+def gram_select(gram, f, m=None, **kwargs):
+    """Selection weights from a (possibly attack-remapped) Gram matrix —
+    the Gram-form interface behind the folded attack path (parallel.fold):
+    ``aggregate(stack) == gram_select(stack @ stack.T) @ stack``."""
+    n = gram.shape[0]
+    if m is None:
+        m = n - f - 2
+    return _selection_weights_from_dist(distances_from_gram(gram), n, f, m)
+
+
 def check(gradients, f, m=None, **kwargs):
     n = num_gradients(gradients)
     if n < 1:
@@ -130,4 +140,5 @@ def influence(honests, attacks, f, m=None, **kwargs):
 
 
 register("krum", aggregate, check, upper_bound=upper_bound,
-         influence=influence, tree_aggregate=tree_aggregate)
+         influence=influence, tree_aggregate=tree_aggregate,
+         gram_select=gram_select)

@@ -38,6 +38,8 @@ __all__ = [
     "apply_gradient_attack",
     "apply_gradient_attack_tree",
     "apply_model_attack",
+    "GradientAttackFold",
+    "plan_gradient_attack_fold",
 ]
 
 
@@ -60,13 +62,21 @@ def _masked_moments(g, mask):
 # --- gradient attacks (byzWorker.py:78-143) --------------------------------
 
 
+# Reference attack defaults (byzWorker.py:108-143), shared by the direct
+# attack functions AND the folded-plan builder so the two application paths
+# can never drift apart.
+LIE_Z = 1.035
+EMPIRE_EPS = 10.0
+REVERSE_FACTOR = -100.0
+
+
 def random_attack(g, mask, *, key, **_):
     """Replace Byzantine rows with uniform[0,1) noise (byzWorker.py:78-85)."""
     fake = jax.random.uniform(key, g.shape, dtype=g.dtype)
     return jnp.where(mask[:, None], fake, g)
 
 
-def reverse_attack(g, mask, *, factor=-100.0, **_):
+def reverse_attack(g, mask, *, factor=REVERSE_FACTOR, **_):
     """Amplified sign-flip: grad * -100 (byzWorker.py:87-94)."""
     return jnp.where(mask[:, None], g * factor, g)
 
@@ -77,7 +87,7 @@ def drop_attack(g, mask, *, key, p=0.3, **_):
     return jnp.where(mask[:, None] & drop, 0.0, g)
 
 
-def lie_attack(g, mask, *, z=1.035, **_):
+def lie_attack(g, mask, *, z=LIE_Z, **_):
     """Little-is-enough: mu + z*sigma over the colluding cohort's honest
     gradients (byzWorker.py:108-125; z_max=1.035 precomputed for n=20, f=8).
     """
@@ -86,7 +96,7 @@ def lie_attack(g, mask, *, z=1.035, **_):
     return jnp.where(mask[:, None], fake[None, :], g)
 
 
-def empire_attack(g, mask, *, eps=10.0, **_):
+def empire_attack(g, mask, *, eps=EMPIRE_EPS, **_):
     """Fall-of-empires: -eps * mu over the colluding cohort
     (byzWorker.py:127-143; eps=10, empirical).
     """
@@ -192,6 +202,107 @@ def apply_gradient_attack_tree(attack, grads_tree, byz_mask, *, key=None,
             kw["key"] = jax.random.fold_in(key, i)
         out.append(fn(flat, mask, **kw).reshape(leaf.shape))
     return jax.tree.unflatten(treedef, out)
+
+
+# --- folded (algebraic) attack application ---------------------------------
+#
+# The deterministic attacks have row-level structure a Gram-based GAR can
+# exploit without ever writing the poisoned rows:
+#   - lie / empire publish ONE shared fake vector from all Byzantine slots
+#     (byzWorker.py:108-143: every colluding worker submits mu + z*sigma /
+#     -eps*mu) -> append the fake as ONE extra stack row and remap;
+#   - reverse scales each Byzantine row by a constant (byzWorker.py:87-94)
+#     -> scale Gram rows/cols and the selection weights;
+#   - crash zeroes the row -> scale 0.
+# The poisoned Gram is then a static row remap + outer scaling of the raw
+# (n+k, n+k) Gram, and the GAR's weighted row sum is one matvec over the
+# extended stack. The raw Gram keeps fusing into the backward epilogue
+# exactly like the fault-free step — the whole-tree `where` rewrite, which
+# forces the stacked gradient tree to rematerialize, never happens. Measured
+# 1.16x on the north-star krum+lie step (PERF.md round 4); the randomized
+# attacks (random, drop) have no such structure and keep the `where` path.
+
+
+class GradientAttackFold:
+    """Static plan for applying a gradient attack inside a Gram-based GAR.
+
+    Poisoned row i == ``row_scale[i] * extended_stack[row_map[i]]`` where
+    ``extended_stack`` is the raw (n, ...) stack with ``num_extra`` (0 or 1)
+    shared fake rows appended. All fields are static (numpy) except
+    ``build_extra``, which builds the fake row tree from the stacked raw
+    gradients at trace time. Consumed by ``parallel.fold``.
+    """
+
+    def __init__(self, row_map, row_scale, build_extra=None):
+        import numpy as np
+
+        self.row_map = np.asarray(row_map, dtype=np.int32)
+        self.row_scale = np.asarray(row_scale, dtype=np.float32)
+        self.build_extra = build_extra
+        self.num_extra = 1 if build_extra is not None else 0
+
+
+def _shared_fake_builder(byz_idx, count, transform):
+    """Per-leaf shared fake row from the Byzantine cohort's honest rows.
+
+    Moments are accumulated in f32 and agree with ``_masked_moments`` to
+    f32 rounding (the masked sum reduces n terms, this one the fw gathered
+    terms — same values, possibly different association, so last-ulp
+    differences are possible); for bf16 pipelines the f32 accumulation is
+    *better* than the where-path's leaf-dtype sums and the two paths agree
+    only to bf16 rounding.
+    """
+
+    def build_extra(stacked_tree):
+        def one(leaf):
+            s = leaf[byz_idx].astype(jnp.float32)
+            mu = jnp.sum(s, axis=0) / count
+            var = jnp.sum((s - mu[None]) ** 2, axis=0) / (count - 1.0)
+            return transform(mu, jnp.sqrt(var)).astype(leaf.dtype)
+
+        return jax.tree.map(one, stacked_tree)
+
+    return build_extra
+
+
+def plan_gradient_attack_fold(attack, byz_mask, *, z=LIE_Z, eps=EMPIRE_EPS,
+                              factor=REVERSE_FACTOR, **_):
+    """Return the ``GradientAttackFold`` for ``attack``, or None when the
+    attack has no folded form (randomized rows, or no Byzantine slots, or
+    ``GARFIELD_NO_FOLD`` set — the A/B escape hatch)."""
+    import os
+
+    import numpy as np
+
+    if attack is None or attack == "none" or os.environ.get("GARFIELD_NO_FOLD"):
+        return None
+    mask = np.asarray(byz_mask, dtype=bool)
+    n = mask.size
+    byz_idx = np.flatnonzero(mask)
+    if byz_idx.size == 0:
+        return None
+    identity = np.arange(n)
+    ones = np.ones(n)
+    if attack == "lie":
+        return GradientAttackFold(
+            np.where(mask, n, identity), ones,
+            _shared_fake_builder(
+                byz_idx, float(byz_idx.size),
+                lambda mu, sigma: mu + z * sigma,
+            ),
+        )
+    if attack == "empire":
+        return GradientAttackFold(
+            np.where(mask, n, identity), ones,
+            _shared_fake_builder(
+                byz_idx, float(byz_idx.size), lambda mu, sigma: -eps * mu
+            ),
+        )
+    if attack == "reverse":
+        return GradientAttackFold(identity, np.where(mask, factor, 1.0))
+    if attack == "crash":
+        return GradientAttackFold(identity, np.where(mask, 0.0, 1.0))
+    return None
 
 
 # --- model attacks (byzServer.py:86-108) -----------------------------------

@@ -38,7 +38,7 @@ from ..attacks import (
     apply_gradient_attack_tree,
     gradient_attacks,
 )
-from . import core, mesh as mesh_lib
+from . import core, fold, mesh as mesh_lib
 
 __all__ = ["make_trainer"]
 
@@ -185,6 +185,9 @@ def make_trainer(
         raise ValueError(f"unknown attack {attack!r}")
     if byz_mask is None:
         byz_mask = core.default_byz_mask(num_workers, f if attack else 0)
+    # Folded attack plan: static for deterministic attacks on Gram-form
+    # rules; None keeps the where-path (fold.plan_for).
+    fold_plan = fold.plan_for(gar, attack, byz_mask, attack_params)
     byz_mask = jnp.asarray(byz_mask, dtype=bool)
 
     init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
@@ -256,16 +259,25 @@ def make_trainer(
             subset=subset, gar_params=gar_params,
         )
         if _tree_path_ok(tree_path, subset, num_workers, granularity, gar):
-            # Tree-mode fast path: poison rows leaf-wise, aggregate without
-            # ever materializing the (n, d) flat stack (PERF.md: the
+            # Tree-mode fast path: no (n, d) flat stack (PERF.md: the
             # flatten + unflatten round trip costs ~5 ms/step at ResNet-18
             # scale on one chip). True subsets go flat — see _tree_path_ok.
-            poisoned = apply_gradient_attack_tree(
-                attack, grads, byz_mask, key=atk_key, **attack_params
-            )
-            aggr_tree = gar.tree_aggregate(
-                poisoned, f=f, key=gar_key, **gar_params
-            )
+            if fold_plan is not None:
+                # Folded attack: poison the Gram, never the rows — the raw
+                # per-leaf Grams keep fusing into the backward epilogue
+                # like the fault-free step (parallel/fold.py; 1.16x on the
+                # krum+lie north-star).
+                aggr_tree = fold.folded_tree_aggregate(
+                    gar, fold_plan, grads, f=f, key=gar_key,
+                    gar_params=gar_params,
+                )
+            else:
+                poisoned = apply_gradient_attack_tree(
+                    attack, grads, byz_mask, key=atk_key, **attack_params
+                )
+                aggr_tree = gar.tree_aggregate(
+                    poisoned, f=f, key=gar_key, **gar_params
+                )
         elif granularity == "layer":
             # Garfield_CC per-parameter aggregation: independent GAR (and
             # attack statistics) per tensor, like the reference's per-layer

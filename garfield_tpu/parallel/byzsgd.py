@@ -49,7 +49,7 @@ from ..attacks import (
     apply_model_attack,
     model_attacks,
 )
-from . import core, mesh as mesh_lib
+from . import core, fold, mesh as mesh_lib
 from .aggregathor import _check_gar, _resolve_gar, _tree_path_ok
 
 __all__ = ["make_trainer"]
@@ -141,6 +141,9 @@ def make_trainer(
         byz_worker_mask = core.default_byz_mask(num_workers, fw if attack else 0)
     if byz_ps_mask is None:
         byz_ps_mask = core.default_byz_mask(num_ps, fps if ps_attack else 0)
+    # Folded attack plan for the gradient phase: static for deterministic
+    # attacks on Gram-form rules; None -> where-path (fold.plan_for).
+    fold_plan = fold.plan_for(gar, attack, byz_worker_mask, attack_params)
     byz_worker_mask = jnp.asarray(byz_worker_mask, bool)
     byz_ps_mask = jnp.asarray(byz_ps_mask, bool)
 
@@ -249,15 +252,22 @@ def make_trainer(
             # no flat stack is built). subset is None here (see tree_ok).
             new_params_list, new_opt_list = [], []
             for k in range(per_ps):
-                poisoned = apply_gradient_attack_tree(
-                    attack, outs[k][0], byz_worker_mask, key=atk_key,
-                    **attack_params,
-                )
-                aggr_tree = gar.tree_aggregate(
-                    poisoned, f=fw,
-                    key=jax.random.fold_in(gar_key, ps_ids[k]),
-                    **gar_params,
-                )
+                slot_gar_key = jax.random.fold_in(gar_key, ps_ids[k])
+                if fold_plan is not None:
+                    # Folded attack: Gram remap instead of row rewrite
+                    # (parallel/fold.py) — same eligibility as aggregathor.
+                    aggr_tree = fold.folded_tree_aggregate(
+                        gar, fold_plan, outs[k][0], f=fw, key=slot_gar_key,
+                        gar_params=gar_params,
+                    )
+                else:
+                    poisoned = apply_gradient_attack_tree(
+                        attack, outs[k][0], byz_worker_mask, key=atk_key,
+                        **attack_params,
+                    )
+                    aggr_tree = gar.tree_aggregate(
+                        poisoned, f=fw, key=slot_gar_key, **gar_params,
+                    )
                 p_k = jax.tree.map(lambda l: l[k], state.params)
                 o_k = jax.tree.map(lambda l: l[k], state.opt_state)
                 aggr_tree = core.cast_like(aggr_tree, p_k)  # no-op at f32

@@ -1,0 +1,94 @@
+"""Folded attack+GAR fast path: poison the Gram, never the rows.
+
+The round-3 profiling conclusion (PERF.md "Known frontier") was that ANY
+gradient attack costs ~4.5 ms/step on the north-star krum+lie config because
+the whole-tree ``where`` rewrite forces the stacked gradient tree to
+materialize and breaks the Gram/weighted-sum-into-backward fusion the
+fault-free step enjoys. This module removes that structural tax for the
+deterministic attacks by exploiting their row-level algebra
+(``attacks.plan_gradient_attack_fold``):
+
+  poisoned row i == row_scale[i] * extended_stack[row_map[i]]
+
+where ``extended_stack`` is the raw stack plus at most one shared fake row
+(lie's mu + z*sigma / empire's -eps*mu, byzWorker.py:108-143 — every
+colluding Byzantine publishes the SAME vector). Consequently
+
+  poisoned_gram = (scale outer scale) * raw_gram[row_map][:, row_map]
+
+is a static remap of the raw ``(n+1, n+1)`` Gram — computed with ONE extra
+row in the per-leaf Gram matmuls that fuse into the backward epilogue
+exactly like the fault-free step — and the GAR's selection average is one
+weighted row sum over the extended stack. Nothing attack-shaped ever touches
+the (n, d)-sized data path.
+
+Measured on the v5e chip (same-process paired-reps, ResNet-18/CIFAR-10, 8
+workers, krum f=2 under lie, bf16 pipeline): 14.4-14.7 -> 12.4-12.6 ms/step
+(1.16x), within 0.6 ms of the fault-free step — where four round-2/3
+attempts that still wrote poisoned rows (elementwise where, row scatter,
+contiguous DUS, flat-path algebraic folding) all measured within noise of
+each other (PERF.md).
+
+Applies when the topology's tree path is eligible, the attack is
+deterministic (lie/empire/reverse/crash), and the rule exposes
+``gram_select`` (krum, average). Randomized attacks (random/drop) and
+coordinate-wise rules keep the ``where`` tree path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..aggregators._common import tree_gram, tree_weighted_sum
+from ..attacks import plan_gradient_attack_fold
+
+__all__ = ["plan_for", "folded_tree_aggregate"]
+
+
+def plan_for(gar, attack, byz_mask, attack_params):
+    """Single-sourced fold eligibility gate for the topology builders
+    (aggregathor AND byzsgd): a plan exists iff the rule has a Gram form
+    and the attack folds (deterministic, with actual Byzantine slots, and
+    GARFIELD_NO_FOLD unset). ``byz_mask`` may be any array-like; it must be
+    concrete (the plan is static)."""
+    if gar.gram_select is None:
+        return None
+    return plan_gradient_attack_fold(
+        attack, np.asarray(byz_mask, dtype=bool), **attack_params
+    )
+
+
+def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
+                          gar_params=None):
+    """Aggregate a stacked gradient TREE under a folded attack plan.
+
+    Args:
+      gar: a registered GAR exposing ``gram_select``.
+      plan: ``attacks.GradientAttackFold`` (static row_map/row_scale +
+        optional shared fake-row builder).
+      stacked_tree: raw per-worker gradients, leading n axis per leaf.
+      f: declared tolerance (static).
+      key: PRNG key forwarded to ``gram_select`` (none of the current
+        Gram-form rules draw randomness; kept for interface parity).
+      gar_params: rule hyper-parameters (e.g. krum's ``m``).
+
+    Returns the aggregated gradient tree (no leading axis) — identical in
+    exact arithmetic to ``gar.tree_aggregate(where-poisoned tree)``.
+    """
+    leaves = jax.tree.leaves(stacked_tree)
+    n = leaves[0].shape[0]
+    ext = stacked_tree
+    if plan.build_extra is not None:
+        extra = plan.build_extra(stacked_tree)
+        ext = jax.tree.map(
+            lambda l, e: jnp.concatenate([l, e[None]], axis=0),
+            stacked_tree, extra,
+        )
+    gram = tree_gram(ext)  # (n+k, n+k), fuses into the backward like f=0
+    rmap = plan.row_map
+    scale = jnp.asarray(plan.row_scale)
+    gram_p = gram[rmap][:, rmap] * (scale[:, None] * scale[None, :])
+    w = gar.gram_select(gram_p, f=f, key=key, **(gar_params or {}))
+    w = w.astype(jnp.float32) * scale
+    w_ext = jnp.zeros((n + plan.num_extra,), jnp.float32).at[rmap].add(w)
+    return tree_weighted_sum(ext, w_ext)

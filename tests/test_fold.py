@@ -1,0 +1,136 @@
+"""Folded attack application (parallel/fold.py + attacks fold plans).
+
+The folded path must be value-equivalent to the reference-semantics where-path
+(poison rows, then aggregate): same attacks, same rules, same stacks — only
+the algebra is restructured (Gram remap instead of row rewrite).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from garfield_tpu.aggregators import gars
+from garfield_tpu.attacks import (
+    apply_gradient_attack_tree,
+    plan_gradient_attack_fold,
+)
+from garfield_tpu.parallel import core
+from garfield_tpu.parallel.fold import folded_tree_aggregate
+
+N, F = 8, 2
+
+
+def _stacked_tree(key, n=N):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "w": jax.random.normal(k1, (n, 5, 3)),
+        "b": jax.random.normal(k2, (n, 7)),
+        "s": jax.random.normal(k3, (n, 1)),
+    }
+
+
+class TestFoldPlans:
+    @pytest.mark.parametrize("attack", ["lie", "empire", "reverse", "crash"])
+    def test_deterministic_attacks_fold(self, attack):
+        plan = plan_gradient_attack_fold(attack, core.default_byz_mask(N, F))
+        assert plan is not None
+        assert plan.row_map.shape == (N,)
+        assert plan.row_scale.shape == (N,)
+
+    @pytest.mark.parametrize("attack", ["random", "drop", None, "none"])
+    def test_unfoldable_attacks_return_none(self, attack):
+        assert plan_gradient_attack_fold(
+            attack, core.default_byz_mask(N, F)
+        ) is None
+
+    def test_no_byzantine_rows_returns_none(self):
+        assert plan_gradient_attack_fold("lie", np.zeros(N, bool)) is None
+
+    def test_env_escape_hatch(self, monkeypatch):
+        monkeypatch.setenv("GARFIELD_NO_FOLD", "1")
+        assert plan_gradient_attack_fold(
+            "lie", core.default_byz_mask(N, F)
+        ) is None
+
+
+class TestFoldedAggregate:
+    @pytest.mark.parametrize("gar_name", ["krum", "average"])
+    @pytest.mark.parametrize("attack", ["lie", "empire", "reverse", "crash"])
+    def test_matches_where_path(self, gar_name, attack):
+        gar = gars[gar_name]
+        mask = core.default_byz_mask(N, F)
+        tree = _stacked_tree(jax.random.PRNGKey(3))
+        plan = plan_gradient_attack_fold(attack, mask)
+        got = folded_tree_aggregate(gar, plan, tree, f=F)
+        poisoned = apply_gradient_attack_tree(attack, tree, jnp.asarray(mask))
+        want = gar.tree_aggregate(poisoned, f=F)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            got, want,
+        )
+
+    def test_matches_where_path_nonstandard_mask(self):
+        """Byzantine rows need not be the trailing slots."""
+        mask = np.zeros(N, bool)
+        mask[[1, 4]] = True
+        tree = _stacked_tree(jax.random.PRNGKey(5))
+        plan = plan_gradient_attack_fold("lie", mask)
+        got = folded_tree_aggregate(gars["krum"], plan, tree, f=F)
+        poisoned = apply_gradient_attack_tree("lie", tree, jnp.asarray(mask))
+        want = gars["krum"].tree_aggregate(poisoned, f=F)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            got, want,
+        )
+
+    def test_krum_m_param_reaches_gram_select(self):
+        mask = core.default_byz_mask(N, F)
+        tree = _stacked_tree(jax.random.PRNGKey(9))
+        plan = plan_gradient_attack_fold("reverse", mask)
+        got = folded_tree_aggregate(
+            gars["krum"], plan, tree, f=F, gar_params={"m": 1}
+        )
+        poisoned = apply_gradient_attack_tree("reverse", tree, jnp.asarray(mask))
+        want = gars["krum"].tree_aggregate(poisoned, f=F, m=1)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            got, want,
+        )
+
+    def test_lie_single_byzantine_nan_cohort(self):
+        """fw=1: Bessel std of a one-row cohort is NaN (torch semantics);
+        both paths must agree — krum treats the NaN fake row as infinitely
+        distant and never selects it."""
+        mask = core.default_byz_mask(N, 1)
+        tree = _stacked_tree(jax.random.PRNGKey(11))
+        plan = plan_gradient_attack_fold("lie", mask)
+        got = folded_tree_aggregate(gars["krum"], plan, tree, f=1)
+        poisoned = apply_gradient_attack_tree("lie", tree, jnp.asarray(mask))
+        want = gars["krum"].tree_aggregate(poisoned, f=1)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            got, want,
+        )
+        for leaf in jax.tree.leaves(got):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_gram_select_consistency(self):
+        """gram_select(stack @ stack.T) @ stack == aggregate(stack)."""
+        g = jax.random.normal(jax.random.PRNGKey(2), (N, 33))
+        gram = g @ g.T
+        w = gars["krum"].gram_select(gram, f=F)
+        np.testing.assert_allclose(
+            np.asarray(w @ g), np.asarray(gars["krum"].unchecked(g, f=F)),
+            rtol=1e-5, atol=1e-6,
+        )
