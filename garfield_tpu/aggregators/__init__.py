@@ -33,7 +33,7 @@ class GAR:
     """
 
     def __init__(self, name, unchecked, check, upper_bound=None, influence=None,
-                 tree_aggregate=None, gram_select=None):
+                 tree_aggregate=None, gram_select=None, fold_aggregate=None):
         self.name = name
         self.unchecked = unchecked
         self.check = check
@@ -53,6 +53,12 @@ class GAR:
         # are never written, and the raw Gram keeps fusing into the
         # backward epilogue (PERF.md round 4: 1.16x on krum+lie).
         self.gram_select = gram_select
+        # Generalization for rules whose output is NOT one weighted row sum
+        # (Bulyan): ``fold_aggregate(gram_p, apply_rows, f, **params)``
+        # receives the poisoned Gram plus an ``apply_rows(W)`` closure that
+        # materializes ``W @ poisoned_stack`` as a stacked tree for any
+        # (r, n) weight matrix — phase-2-style reductions then run on it.
+        self.fold_aggregate = fold_aggregate
 
         def checked(gradients, *args, **kwargs):
             message = check(gradients, *args, **kwargs)
@@ -78,13 +84,13 @@ gars = {}
 
 
 def register(name, unchecked, check, upper_bound=None, influence=None,
-             tree_aggregate=None, gram_select=None):
+             tree_aggregate=None, gram_select=None, fold_aggregate=None):
     """Register an aggregation rule (reference __init__.py:71-86)."""
     if name in gars:
         tools.warning(f"GAR {name!r} already registered; overwriting")
     gar = GAR(name, unchecked, check, upper_bound=upper_bound,
               influence=influence, tree_aggregate=tree_aggregate,
-              gram_select=gram_select)
+              gram_select=gram_select, fold_aggregate=fold_aggregate)
     gars[name] = gar
     return gar
 

@@ -130,6 +130,33 @@ def tree_coordinatewise(fn, stacked_tree):
     ])
 
 
+def concat_stack(leaves):
+    """(stack, shapes): ONE axis-1 concat of the reshaped stacked leaves.
+
+    The concat-first layout for rules that want a flat (n, d) stack anyway
+    (Bulyan's selection matmul + fused phase-2): measured cheaper than the
+    flat path's vmapped ravel_pytree (PERF.md r4). ``shapes`` feeds
+    ``unflatten_vec`` — single-sourced here so the tree and folded paths
+    cannot drift."""
+    n = leaves[0].shape[0]
+    stack = jnp.concatenate([l.reshape(n, -1) for l in leaves], axis=1)
+    return stack, [l.shape[1:] for l in leaves]
+
+
+def unflatten_vec(vec, treedef, shapes):
+    """Slice a flat (d,) vector back into a pytree with the given leaf
+    ``shapes`` (leaf-order spans, the inverse of an axis-1 concat of
+    reshaped leaves). Shared by tree-mode Bulyan and the folded path."""
+    off, parts = 0, []
+    for shape in shapes:
+        sz = 1
+        for s in shape:
+            sz *= s
+        parts.append(vec[off:off + sz].reshape(shape))
+        off += sz
+    return jax.tree.unflatten(treedef, parts)
+
+
 def coordinate_median(g):
     """Lower coordinate-wise median of a (n, d) stack -> (d,).
 

@@ -31,25 +31,29 @@ import jax
 import jax.numpy as jnp
 
 from . import register
-from ._common import as_stack, num_gradients, pairwise_distances
+from ._common import (
+    as_stack,
+    concat_stack,
+    distances_from_gram,
+    num_gradients,
+    pairwise_distances,
+    unflatten_vec,
+)
 
 
-def aggregate(gradients, f, m=None, **kwargs):
-    """Bulyan over Multi-Krum."""
-    g = as_stack(gradients)
-    n, d = g.shape
+def _selection_weight_matrix(dist, n, f, m, dtype):
+    """Phase-1 selection as a (rounds, n) weight matrix.
+
+    The selection loop only needs the (n, n) distance matrix: each round
+    scores the active nodes, records the Multi-Krum selection *weights*
+    (1/m_i on the m_i best, 0 elsewhere), and prunes the best node. The
+    selected averages are then weight matmuls after the loop — the loop
+    never touches the d-sized data, so the whole phase costs a single MXU
+    pass over the stack (flat) or one matmul per leaf (tree).
+    """
     m_max = n - f - 2
-    if m is None:
-        m = m_max
     rounds = n - 2 * f - 2
-    dist = pairwise_distances(g)  # (n, n), diag/non-finite -> +inf
 
-    # The selection loop only needs the (n, n) distance matrix: each round
-    # scores the active nodes, records the Multi-Krum selection *weights*
-    # (1/m_i on the m_i best, 0 elsewhere), and prunes the best node. The
-    # selected averages are then ONE (rounds, n) @ (n, d) matmul after the
-    # loop — the loop never touches the d-sized stack, so the whole phase
-    # costs a single MXU pass over g instead of rounds x (gather + cumsum).
     def round_body(i, carry):
         active, weights = carry
         m_i = jnp.minimum(m, m_max - i)
@@ -59,16 +63,28 @@ def aggregate(gradients, f, m=None, **kwargs):
         scores = jax.lax.dynamic_index_in_dim(csum, m_i - 1, axis=1, keepdims=False)
         scores = jnp.where(active, scores, jnp.inf)
         order = jnp.argsort(scores)  # stable: ties break on lowest index
-        w = jnp.zeros((n,), g.dtype).at[order].set(
-            (jnp.arange(n) < m_i).astype(g.dtype) / m_i
+        w = jnp.zeros((n,), dtype).at[order].set(
+            (jnp.arange(n) < m_i).astype(dtype) / m_i
         )
         weights = weights.at[i].set(w)
         active = active.at[order[0]].set(False)
         return active, weights
 
     active0 = jnp.ones((n,), dtype=bool)
-    weights0 = jnp.zeros((rounds, n), dtype=g.dtype)
+    weights0 = jnp.zeros((rounds, n), dtype=dtype)
     _, weights = jax.lax.fori_loop(0, rounds, round_body, (active0, weights0))
+    return weights
+
+
+def aggregate(gradients, f, m=None, **kwargs):
+    """Bulyan over Multi-Krum."""
+    g = as_stack(gradients)
+    n, d = g.shape
+    if m is None:
+        m = n - f - 2
+    rounds = n - 2 * f - 2
+    dist = pairwise_distances(g)  # (n, n), diag/non-finite -> +inf
+    weights = _selection_weight_matrix(dist, n, f, m, g.dtype)
     # Rows never selected in any round must not poison the matmul with
     # NaN/Inf coordinates (0 * inf = nan); rows that are selected pass
     # through untouched (reference mean semantics).
@@ -84,6 +100,68 @@ def aggregate(gradients, f, m=None, **kwargs):
 
     beta = rounds - 2 * f
     return ops.averaged_median_mean(selected, beta)
+
+
+def _select_and_phase2(stack, weights, treedef, shapes, beta):
+    """Shared tail of the tree/folded paths: ONE selection matmul over the
+    concatenated stack, ONE fused phase-2 kernel, slice back per leaf.
+
+    Per-leaf (rounds, n) @ (n, size) matmuls were measured to eat the whole
+    tree-path win at ResNet-18 scale (62 launches, each padded to the MXU
+    tile) and per-leaf phase-2 kernels likewise; the single-concat form is
+    the bucket-all layout that measured fastest (PERF.md round 4).
+    """
+    from .. import ops
+
+    used = jnp.any(weights != 0, axis=0)
+    selected = jnp.matmul(
+        weights.astype(stack.dtype), jnp.where(used[:, None], stack, 0)
+    )  # (rounds, d)
+    return unflatten_vec(
+        ops.averaged_median_mean(selected, beta), treedef, shapes
+    )
+
+
+def tree_aggregate(grads_tree, f, m=None, **kwargs):
+    """Tree-mode Bulyan: concat-first.
+
+    Unlike Krum (whose Gram + weighted-sum both decompose per leaf and fuse
+    into the backward), Bulyan's selection MATMUL and fused phase-2 kernel
+    want one flat stack anyway — and per-leaf Grams measured SLOWER than a
+    single flat Gram here (PERF.md round 4). So the tree twin's job is only
+    to build that stack cheaply: ONE axis-1 concat of the reshaped stacked
+    leaves (measured faster than the flat path's vmapped ravel_pytree) and
+    a sliced unflatten of the result.
+    """
+    leaves, treedef = jax.tree.flatten(grads_tree)
+    n = leaves[0].shape[0]
+    if m is None:
+        m = n - f - 2
+    rounds = n - 2 * f - 2
+    beta = rounds - 2 * f
+    stack, shapes = concat_stack(leaves)
+    dist = pairwise_distances(stack)
+    weights = _selection_weight_matrix(dist, n, f, m, jnp.float32)
+    return _select_and_phase2(stack, weights, treedef, shapes, beta)
+
+
+def fold_aggregate(gram_p, apply_rows, f, m=None, **kwargs):
+    """Folded-attack Bulyan (parallel.fold): phase 1 runs on the poisoned
+    Gram (a static remap of the raw extended Gram — the rows are never
+    rewritten); ``apply_rows`` materializes the per-round selected averages
+    as one remapped weight matmul over the concatenated extended stack, and
+    phase 2 is one fused kernel over the resulting (rounds, d)."""
+    from .. import ops
+
+    n = gram_p.shape[0]
+    if m is None:
+        m = n - f - 2
+    rounds = n - 2 * f - 2
+    beta = rounds - 2 * f
+    dist = distances_from_gram(gram_p)
+    weights = _selection_weight_matrix(dist, n, f, m, jnp.float32)
+    selected, unflatten = apply_rows(weights)  # (rounds, d)
+    return unflatten(ops.averaged_median_mean(selected, beta))
 
 
 def check(gradients, f, m=None, **kwargs):
@@ -110,4 +188,5 @@ def upper_bound(n, f, d):
     )
 
 
-register("bulyan", aggregate, check, upper_bound=upper_bound)
+register("bulyan", aggregate, check, upper_bound=upper_bound,
+         tree_aggregate=tree_aggregate, fold_aggregate=fold_aggregate)

@@ -48,10 +48,11 @@ __all__ = ["plan_for", "folded_tree_aggregate"]
 def plan_for(gar, attack, byz_mask, attack_params):
     """Single-sourced fold eligibility gate for the topology builders
     (aggregathor AND byzsgd): a plan exists iff the rule has a Gram form
-    and the attack folds (deterministic, with actual Byzantine slots, and
-    GARFIELD_NO_FOLD unset). ``byz_mask`` may be any array-like; it must be
-    concrete (the plan is static)."""
-    if gar.gram_select is None:
+    (``gram_select`` or ``fold_aggregate``) and the attack folds
+    (deterministic, with actual Byzantine slots, and GARFIELD_NO_FOLD
+    unset). ``byz_mask`` may be any array-like; it must be concrete (the
+    plan is static)."""
+    if gar.gram_select is None and gar.fold_aggregate is None:
         return None
     return plan_gradient_attack_fold(
         attack, np.asarray(byz_mask, dtype=bool), **attack_params
@@ -63,32 +64,89 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
     """Aggregate a stacked gradient TREE under a folded attack plan.
 
     Args:
-      gar: a registered GAR exposing ``gram_select``.
+      gar: a registered GAR exposing ``gram_select`` or ``fold_aggregate``.
       plan: ``attacks.GradientAttackFold`` (static row_map/row_scale +
         optional shared fake-row builder).
       stacked_tree: raw per-worker gradients, leading n axis per leaf.
       f: declared tolerance (static).
-      key: PRNG key forwarded to ``gram_select`` (none of the current
-        Gram-form rules draw randomness; kept for interface parity).
+      key: PRNG key forwarded to the rule (none of the current Gram-form
+        rules draw randomness; kept for interface parity).
       gar_params: rule hyper-parameters (e.g. krum's ``m``).
 
     Returns the aggregated gradient tree (no leading axis) — identical in
     exact arithmetic to ``gar.tree_aggregate(where-poisoned tree)``.
+
+    Two layouts, each the measured winner for its rule family (PERF.md r4):
+
+      - ``gram_select`` rules (krum, average) consume the stack only via
+        Gram + one weighted row sum, both of which decompose per leaf — the
+        extended stack stays a TREE and the per-leaf Grams fuse into the
+        backward epilogue;
+      - ``fold_aggregate`` rules (Bulyan) need a flat stack for the
+        selection matmul and the fused phase-2 kernel anyway, and per-leaf
+        Grams measured SLOWER here — so the stack is concatenated ONCE and
+        the extension is assembled in BLOCK form (raw Gram, cross-dots c,
+        |a|^2) without ever materializing an (n+1, d) array.
     """
-    leaves = jax.tree.leaves(stacked_tree)
+    leaves, treedef = jax.tree.flatten(stacked_tree)
     n = leaves[0].shape[0]
-    ext = stacked_tree
-    if plan.build_extra is not None:
-        extra = plan.build_extra(stacked_tree)
-        ext = jax.tree.map(
-            lambda l, e: jnp.concatenate([l, e[None]], axis=0),
-            stacked_tree, extra,
-        )
-    gram = tree_gram(ext)  # (n+k, n+k), fuses into the backward like f=0
     rmap = plan.row_map
     scale = jnp.asarray(plan.row_scale)
-    gram_p = gram[rmap][:, rmap] * (scale[:, None] * scale[None, :])
-    w = gar.gram_select(gram_p, f=f, key=key, **(gar_params or {}))
-    w = w.astype(jnp.float32) * scale
-    w_ext = jnp.zeros((n + plan.num_extra,), jnp.float32).at[rmap].add(w)
-    return tree_weighted_sum(ext, w_ext)
+    scale_outer = scale[:, None] * scale[None, :]
+    params = gar_params or {}
+
+    if gar.gram_select is not None:
+        ext = stacked_tree
+        if plan.build_extra is not None:
+            extra = plan.build_extra(stacked_tree)
+            ext = jax.tree.map(
+                lambda l, e: jnp.concatenate([l, e[None]], axis=0),
+                stacked_tree, extra,
+            )
+        gram = tree_gram(ext)  # (n+k, n+k), fuses into the backward like f=0
+        gram_p = gram[rmap][:, rmap] * scale_outer
+        w = gar.gram_select(gram_p, f=f, key=key, **params)
+        w = w.astype(jnp.float32) * scale
+        w_ext = jnp.zeros((n + plan.num_extra,), jnp.float32).at[rmap].add(w)
+        return tree_weighted_sum(ext, w_ext)
+
+    # fold_aggregate rules: flat-block layout.
+    from ..aggregators._common import concat_stack, unflatten_vec
+
+    stack, shapes = concat_stack(leaves)
+    acc = jnp.promote_types(stack.dtype, jnp.float32)
+    gram = jnp.matmul(stack, stack.T, preferred_element_type=acc)
+    a_flat = None
+    if plan.build_extra is not None:
+        extra = plan.build_extra(stacked_tree)
+        a_flat = jnp.concatenate(
+            [l.reshape(-1) for l in jax.tree.leaves(extra)]
+        )
+        c = jnp.matmul(stack, a_flat, preferred_element_type=acc)  # <g_i, a>
+        aa = jnp.dot(a_flat, a_flat, preferred_element_type=acc)
+        gram = jnp.concatenate([
+            jnp.concatenate([gram, c[:, None]], axis=1),
+            jnp.concatenate([c[None, :], aa[None, None]], axis=1),
+        ], axis=0)  # (n+1, n+1), no (n+1, d) array ever built
+    gram_p = gram[rmap][:, rmap] * scale_outer
+
+    def apply_rows(W):
+        """(r, n) selection weights -> (W @ poisoned_stack, unflatten)."""
+        r = W.shape[0]
+        W_s = W.astype(jnp.float32) * scale[None, :]
+        W_ext = jnp.zeros((r, n + plan.num_extra), jnp.float32).at[
+            :, rmap
+        ].add(W_s)
+        used = jnp.any(W_ext != 0, axis=0)
+        selected = jnp.matmul(
+            W_ext[:, :n].astype(stack.dtype),
+            jnp.where(used[:n, None], stack, 0),
+        )
+        if a_flat is not None:
+            a_safe = jnp.where(used[n], a_flat, 0)  # NaN fake x 0 weight
+            selected = selected + jnp.outer(
+                W_ext[:, n].astype(stack.dtype), a_safe
+            )
+        return selected, lambda vec: unflatten_vec(vec, treedef, shapes)
+
+    return gar.fold_aggregate(gram_p, apply_rows, f=f, key=key, **params)

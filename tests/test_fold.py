@@ -57,16 +57,20 @@ class TestFoldPlans:
 
 
 class TestFoldedAggregate:
-    @pytest.mark.parametrize("gar_name", ["krum", "average"])
+    # bulyan (n >= 4f+3) runs at f=1; it exercises the fold_aggregate branch
+    # (weight-MATRIX apply_rows), the others the gram_select branch.
+    @pytest.mark.parametrize("gar_name,f", [
+        ("krum", F), ("average", F), ("bulyan", 1),
+    ])
     @pytest.mark.parametrize("attack", ["lie", "empire", "reverse", "crash"])
-    def test_matches_where_path(self, gar_name, attack):
+    def test_matches_where_path(self, gar_name, f, attack):
         gar = gars[gar_name]
-        mask = core.default_byz_mask(N, F)
+        mask = core.default_byz_mask(N, f)
         tree = _stacked_tree(jax.random.PRNGKey(3))
         plan = plan_gradient_attack_fold(attack, mask)
-        got = folded_tree_aggregate(gar, plan, tree, f=F)
+        got = folded_tree_aggregate(gar, plan, tree, f=f)
         poisoned = apply_gradient_attack_tree(attack, tree, jnp.asarray(mask))
-        want = gar.tree_aggregate(poisoned, f=F)
+        want = gar.tree_aggregate(poisoned, f=f)
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6

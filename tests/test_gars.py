@@ -452,6 +452,29 @@ def test_cclip_tree_matches_flat():
     np.testing.assert_allclose(flat_from_tree, flat_out, rtol=1e-5, atol=1e-6)
 
 
+def test_bulyan_tree_matches_flat():
+    """r4 tree-mode Bulyan (concat-first: one axis-1 concat, one Gram, one
+    fused phase-2) must agree with the flat path on a multi-leaf pytree."""
+    import jax
+
+    leaves = {
+        "w": RNG.normal(size=(9, 4, 3)).astype(np.float32),
+        "b": RNG.normal(size=(9, 5)).astype(np.float32),
+    }
+    flat = np.concatenate(
+        [np.asarray(l).reshape(9, -1) for l in jax.tree.leaves(leaves)],
+        axis=1,
+    )
+    tree_out = gars["bulyan"].tree_aggregate(
+        jax.tree.map(jnp.asarray, leaves), f=1
+    )
+    flat_from_tree = np.concatenate(
+        [np.asarray(l).reshape(-1) for l in jax.tree.leaves(tree_out)]
+    )
+    flat_out = np.asarray(gars["bulyan"](flat, f=1))
+    np.testing.assert_allclose(flat_from_tree, flat_out, rtol=1e-5, atol=1e-6)
+
+
 def test_cclip_checked_contract():
     with pytest.raises(AssertionError):
         gars["cclip"].checked(stack(5, 4), f=3)  # needs n >= 2f+1 = 7

@@ -72,6 +72,9 @@ class TestAggregathor:
         ("cclip", "lie", 2, None),
         ("median", "lie", 2, None),
         ("tmean", "reverse", 2, None),
+        # r4: tree-mode Bulyan (concat-first; with a foldable attack this
+        # row drives the FOLDED path); f=1 because Bulyan needs n >= 4f+3.
+        ("bulyan", "lie", 1, None),
     ])
     def test_tree_path_matches_flat_path(self, gar, attack, f, subset):
         """The tree-mode fast path (no flat (n, d) stack) must produce the
@@ -84,6 +87,30 @@ class TestAggregathor:
             init_fn, step_fn, _ = aggregathor.make_trainer(
                 module, loss, opt, gar, num_workers=8, f=f, attack=attack,
                 subset=subset, tree_path=tree_path,
+            )
+            state = init_fn(jax.random.PRNGKey(0), x[0])
+            state, losses = _run(step_fn, state, x, y, 5)
+            runs.append((losses, jax.device_get(state.params)))
+        np.testing.assert_allclose(runs[0][0], runs[1][0], rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+            runs[0][1], runs[1][1],
+        )
+
+    @pytest.mark.parametrize("gar,f", [("krum", 2), ("bulyan", 1)])
+    def test_tree_where_path_matches_flat(self, gar, f, monkeypatch):
+        """With GARFIELD_NO_FOLD the tree branch takes the where-path
+        (apply_gradient_attack_tree + gar.tree_aggregate) — the foldable
+        attacks otherwise dispatch to parallel.fold, leaving that branch
+        without end-to-end coverage."""
+        monkeypatch.setenv("GARFIELD_NO_FOLD", "1")
+        module, loss, opt = _pima_setup()
+        x, y = _pima_batches(8, 16)
+        runs = []
+        for tree_path in (True, False):
+            init_fn, step_fn, _ = aggregathor.make_trainer(
+                module, loss, opt, gar, num_workers=8, f=f, attack="lie",
+                tree_path=tree_path,
             )
             state = init_fn(jax.random.PRNGKey(0), x[0])
             state, losses = _run(step_fn, state, x, y, 5)
