@@ -33,7 +33,27 @@ def main(argv=None):
         help="GAR for the model gather step (default: same as --gar, "
              "ByzSGD/trainer.py:34 note).",
     )
+    parser.add_argument(
+        "--cluster", type=str, default=None,
+        help="Cluster config JSON: run as ONE process of a multi-process "
+             "MSMW deployment over PeerExchange — every PS a real process "
+             "(a Byzantine one via --ps_attack), true wait-n-f on the "
+             "gradient plane (the reference's per-app run_exp.sh shape).",
+    )
+    parser.add_argument(
+        "--task", type=str, default=None,
+        help='Role override for --cluster, "ps:K" or "worker:K".',
+    )
+    parser.add_argument(
+        "--cluster_timeout_ms", type=int, default=60_000,
+        help="Per-step collect timeout in cluster mode.",
+    )
     args = parser.parse_args(argv)
+    if args.cluster:
+        from . import cluster
+
+        args.num_workers = args.num_ps = None  # counts come from the config
+        return cluster.run(args)
     assert args.fw * 2 < args.num_workers
     assert args.fps * 2 < args.num_ps or args.fps == 0
     return common.train(

@@ -38,7 +38,40 @@ def main(argv=None):
         "--no_model_gossip", action="store_true",
         help="Disable the model gossip phase (LEARN/trainer.py:255-257).",
     )
+    parser.add_argument(
+        "--model_attack_params", type=__import__("json").loads, default={},
+        help="Model-attack parameters as JSON.",
+    )
+    parser.add_argument(
+        "--model_gar", type=str, default=None,
+        help="GAR for the model gossip (default: same as --gar).",
+    )
+    parser.add_argument(
+        "--cluster", type=str, default=None,
+        help='Cluster config JSON with a "node" host list: run as ONE peer '
+             "of the decentralized multi-process LEARN deployment over "
+             "PeerExchange (true per-node wait-n-f; LEARN/trainer.py's "
+             "run_exp.sh fan-out shape).",
+    )
+    parser.add_argument(
+        "--task", type=str, default=None,
+        help='Role override for --cluster, "node:K".',
+    )
+    parser.add_argument(
+        "--cluster_timeout_ms", type=int, default=60_000,
+        help="Per-phase collect timeout in cluster mode.",
+    )
     args = parser.parse_args(argv)
+    if args.cluster:
+        from . import cluster
+
+        args.num_workers = None  # node count comes from the config
+        return cluster.run(args)
+    if args.model_gar is not None:
+        # The on-mesh LEARN uses ONE rule for gradients and gossip (the
+        # reference does too, LEARN/trainer.py); a separate model rule
+        # exists only in the cluster deployment.
+        raise SystemExit("--model_gar requires --cluster (node deployment)")
     assert args.fw * 2 < args.num_workers or args.fw == 0
     return common.train(
         args,
@@ -49,6 +82,7 @@ def main(argv=None):
             attack=args.attack,
             attack_params=args.attack_params,
             model_attack=args.model_attack,
+            model_attack_params=args.model_attack_params,
             non_iid=args.non_iid,
             model_gossip=not args.no_model_gossip,
             subset=args.subset,

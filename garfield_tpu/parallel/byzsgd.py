@@ -114,14 +114,21 @@ def make_trainer(
     ``ps_axis``.
     """
     gar = _resolve_gar(gar)
-    model_gar = gar if model_gar is None else _resolve_gar(model_gar)
+    same_rule = model_gar is None
+    model_gar = gar if same_rule else _resolve_gar(model_gar)
     attack_params = dict(attack_params or {})
     gar_params = dict(gar_params or {})
-    # The model-space rule defaults to the gradient rule; its params follow
-    # the same convention unless overridden.
-    model_gar_params = dict(
-        gar_params if model_gar_params is None else model_gar_params
-    )
+    # The model-space rule defaults to the gradient rule, and only then do
+    # its params follow gar_params too. When model_gar is an explicitly
+    # DIFFERENT rule, inheriting gradient-rule hyperparameters would be
+    # silent misconfiguration (e.g. a cclip tau scaled to gradient radii
+    # applied to model vectors, orders of magnitude larger — and unknown
+    # keys vanish into the rules' **kwargs), so they default to {} there
+    # (ADVICE r3).
+    if model_gar_params is None:
+        model_gar_params = dict(gar_params) if same_rule else {}
+    else:
+        model_gar_params = dict(model_gar_params)
     ps_attack_params = dict(ps_attack_params or {})
     if mesh is None:
         mesh = mesh_lib.make_mesh({ps_axis: 1, axis: -1})

@@ -155,7 +155,16 @@ def segmented_aggregate(agg_fn, stack, segments):
 # vmap: the unroll duplicates the model's fwd+bwd graph per slot and compile
 # time grows linearly. (On a real multi-chip mesh per-shard slot counts are
 # 1-2 and the unroll is always used.)
-UNROLL_MAX_SLOTS = 16
+#
+# Measured end-to-end at n=64 on the chip (PERF.md r4: ResNet-18, b=25,
+# krum+lie): vmap fallback 127 ms/step (12.6k img/s, compile 6 s) vs forced
+# unroll 103 ms/step (15.6k img/s, compile 136 s) — the relayout tax at
+# n=64 is ~19%, far below the 36-63% measured at n=8, and the unroll
+# amortizes its compile in ~5.4k steps. For reference-scale runs (100k
+# iters) raising the cap is a win: override with GARFIELD_UNROLL_MAX_SLOTS.
+import os as _os
+
+UNROLL_MAX_SLOTS = int(_os.environ.get("GARFIELD_UNROLL_MAX_SLOTS", 16))
 
 
 def per_slot_grads(grad_fn, params, ms, x, y, keys):

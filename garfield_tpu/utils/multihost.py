@@ -52,6 +52,10 @@ class ClusterConfig:
         cluster = self.spec.get("cluster", {})
         self.workers = list(cluster.get("worker", []))
         self.ps = list(cluster.get("ps", []))
+        # Decentralized (LEARN) deployments have no ps/worker split: every
+        # process is a peer "node" (LEARN/trainer.py:224-231 — each rank
+        # constructs both a Worker and a Server).
+        self.nodes = list(cluster.get("node", []))
         task = self.spec.get("task", {"type": "worker", "index": 0})
         self.task_type = task.get("type", "worker")
         self.task_index = int(task.get("index", 0))
@@ -69,7 +73,7 @@ class ClusterConfig:
 
     @property
     def hosts(self):
-        return self.ps + self.workers
+        return self.nodes if self.nodes else self.ps + self.workers
 
     @property
     def num_processes(self):
@@ -77,6 +81,8 @@ class ClusterConfig:
 
     @property
     def process_id(self):
+        if self.task_type == "node":
+            return self.task_index
         base = 0 if self.task_type == "ps" else len(self.ps)
         return base + self.task_index
 
@@ -86,12 +92,19 @@ class ClusterConfig:
         return self.hosts[0] if self.hosts else None
 
 
-def generate_config(path, *, workers, ps=(), task_type="worker", task_index=0,
-                    **garfield):
+def generate_config(path, *, workers=(), ps=(), nodes=(), task_type="worker",
+                    task_index=0, **garfield):
     """Write a cluster config JSON (config_generator.py:30-90 counterpart,
-    non-interactive)."""
+    non-interactive). ``nodes`` describes a decentralized (LEARN) peer
+    deployment and is mutually exclusive with ps/workers."""
+    if nodes and (workers or ps):
+        raise ValueError("a node (LEARN) cluster has no ps/worker split")
+    cluster = (
+        {"node": list(nodes)} if nodes
+        else {"worker": list(workers), "ps": list(ps)}
+    )
     spec = {
-        "cluster": {"worker": list(workers), "ps": list(ps)},
+        "cluster": cluster,
         "task": {"type": task_type, "index": task_index},
         "garfield": garfield,
     }

@@ -220,3 +220,36 @@ def test_bench_driver_artifact_smoke():
     assert out["unit"] == "steps/s/chip"
     assert out["metric"].endswith("w4_f1_median_lie")
     assert out["vs_baseline"] is None  # off-default config: no ratchet ratio
+
+
+def test_cluster_host_attack_cohort_math():
+    """The cluster attacker's lie/empire statistics must match the
+    reference formulas (byzWorker.py:108-143) on a known cohort stack."""
+    import numpy as np
+
+    from garfield_tpu.apps.cluster import _host_attack
+
+    stack = np.asarray(
+        [[1.0, 2.0, 3.0], [3.0, 6.0, 1.0]], dtype=np.float32
+    )
+    kind, fn, cohort = _host_attack("lie", {}, fw=2)
+    assert (kind, cohort) == ("cohort", 2)
+    mu = stack.mean(0)
+    sigma = stack.std(0, ddof=1)
+    np.testing.assert_allclose(fn(stack), mu + 1.035 * sigma, rtol=1e-6)
+
+    kind, fn, cohort = _host_attack("empire", {"eps": 4.0, "cohort": 3}, fw=2)
+    assert (kind, cohort) == ("cohort", 3)
+    np.testing.assert_allclose(fn(stack), -4.0 * mu, rtol=1e-6)
+
+    # fw=1 cohort: Bessel sigma is NaN, like torch.std of one sample.
+    kind, fn, cohort = _host_attack("lie", {}, fw=1)
+    out = fn(stack[:1])
+    assert np.isnan(out).all()
+
+    kind, fn, _ = _host_attack("reverse", {}, fw=1)
+    assert kind == "post"
+    np.testing.assert_allclose(fn(stack[0]), -100.0 * stack[0])
+
+    with pytest.raises(SystemExit):
+        _host_attack("unknown-attack", {}, fw=1)

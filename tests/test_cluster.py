@@ -69,10 +69,10 @@ def _cluster_setup(tmp_path, n_w):
     return cfg_path, env
 
 
-def _launch(role, cfg_path, env, extra=()):
+def _launch(role, cfg_path, env, extra=(), module="aggregathor"):
     return subprocess.Popen(
         [
-            sys.executable, "-m", "garfield_tpu.apps.aggregathor",
+            sys.executable, "-m", f"garfield_tpu.apps.{module}",
             "--cluster", cfg_path, "--task", role,
             "--dataset", "mnist", "--model", "convnet", "--batch", "16",
             "--fw", "1", "--gar", "median", "--num_iter", "60",
@@ -176,39 +176,292 @@ def test_cluster_momentum_cclip_defense(tmp_path):
     )
 
 
+def test_byzsgd_cluster_byzantine_ps_tolerated(tmp_path):
+    """Multi-process ByzSGD (MSMW): every PS a REAL process, one of them
+    Byzantine. 3 PS replicas (1-of-2 Byzantine is information-theoretically
+    untolerable, so the minimal honest-majority deployment is 3 with
+    fps=1) x 4 workers; PS 2 runs --ps_attack reverse and publishes
+    -100x its model every step (byzServer.py:86-108 as a live process).
+    Every node GAR-aggregates the 3 models with median before use
+    (the gather step, ByzSGD/trainer.py:240-244), so the honest replicas
+    must converge."""
+    n_ps, n_w = 3, 4
+    from garfield_tpu.utils import multihost
+
+    pp = _ports(n_ps + n_w)
+    cfg_path = str(tmp_path / "cluster.json")
+    multihost.generate_config(
+        cfg_path,
+        ps=[f"127.0.0.1:{p}" for p in pp[:n_ps]],
+        workers=[f"127.0.0.1:{p}" for p in pp[n_ps:]],
+        task_type="ps", task_index=0,
+    )
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO
+    env["GARFIELD_SURROGATE_MARGIN"] = "30"
+    env["GARFIELD_SURROGATE_LABEL_NOISE"] = "0"
+    n_iter = 60
+    base = (
+        "--fps", "1", "--model_gar", "median", "--num_iter", str(n_iter),
+    )
+    pses = [
+        _launch(
+            f"ps:{p}", cfg_path, env, module="byzsgd",
+            extra=base + (
+                ("--ps_attack", "reverse") if p == n_ps - 1 else ()
+            ),
+        )
+        for p in range(n_ps)
+    ]
+    workers = [
+        _launch(f"worker:{w}", cfg_path, env, module="byzsgd", extra=base)
+        for w in range(n_w)
+    ]
+    procs = pses + workers
+    try:
+        for p_idx, ps in enumerate(pses):
+            out, _ = ps.communicate(timeout=400 + 5 * n_iter)
+            assert ps.returncode == 0, f"PS {p_idx} failed:\n{out[-2000:]}"
+            if p_idx == n_ps - 1:
+                continue  # the Byzantine replica's own numbers are garbage
+            summary = json.loads(
+                [l for l in out.splitlines() if l.startswith("{")][-1]
+            )
+            assert summary["steps"] == n_iter
+            first_acc = float(
+                [l for l in out.splitlines() if l.startswith("Step: 0 ")][0]
+                .split()[3]
+            )
+            assert summary["final_accuracy"] > max(0.3, first_acc + 0.1), (
+                f"honest PS {p_idx} did not converge: {summary}"
+            )
+        for w in workers:
+            wout, _ = w.communicate(timeout=120)
+            assert w.returncode == 0, f"worker failed:\n{wout[-1500:]}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_learn_cluster_node_crash_survivors_converge(tmp_path):
+    """Multi-process LEARN: every node a real worker+server process
+    gossiping gradients AND models over PeerExchange at per-node wait-n-f
+    (LEARN/trainer.py:224-257). One of 5 nodes is SIGKILLed mid-run; the
+    survivors' q = n - f = 3 quorums flow around the corpse on both
+    planes. f=2 (not 1) so the budget covers the kill PLUS one
+    contention straggler: at q = survivors the quorums have zero slack
+    and a single 120 s starvation on this 1-core box cascades into a
+    full stall (observed in full-suite runs)."""
+    n = 5
+    from garfield_tpu.utils import multihost
+
+    pp = _ports(n)
+    cfg_path = str(tmp_path / "cluster.json")
+    multihost.generate_config(
+        cfg_path,
+        nodes=[f"127.0.0.1:{p}" for p in pp],
+        task_type="node", task_index=0,
+    )
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO
+    env["GARFIELD_SURROGATE_MARGIN"] = "30"
+    env["GARFIELD_SURROGATE_LABEL_NOISE"] = "0"
+    n_iter = 60
+    # the learn app defaults to --loss bce (pima); this test runs mnist.
+    # --fw 2 overrides _launch's default fw=1 (see docstring).
+    extra = ("--num_iter", str(n_iter), "--loss", "nll", "--fw", "2")
+    nodes = [
+        _launch(f"node:{k}", cfg_path, env, module="learn", extra=extra)
+        for k in range(n)
+    ]
+    victim = nodes[-1]
+    watchdog = threading.Timer(900, lambda: [p.kill() for p in nodes])
+    watchdog.start()
+    try:
+        # Wait until training is demonstrably under way on node 0, then
+        # SIGKILL the last node — a hard crash mid-gossip.
+        first_acc = None
+        head = []
+        for line in nodes[0].stdout:
+            head.append(line)
+            if line.startswith("Step: 0 "):
+                first_acc = float(line.split()[3])
+            if line.startswith("Step: 10 "):
+                break
+        assert first_acc is not None, (
+            "node 0 never reported step-0 accuracy:\n" + "".join(head)[-2000:]
+        )
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=30)
+        rest = "".join(head) + nodes[0].stdout.read()
+        nodes[0].wait(timeout=600)
+        watchdog.cancel()
+        outs = [rest]
+        for k in (1, 2, 3):
+            out, _ = nodes[k].communicate(timeout=600)
+            outs.append(out)
+        # System-level guarantee, not per-node: every survivor exits
+        # cleanly (a box-contention straggler may gracefully drop out —
+        # the bounded-retry semantics — but must not crash), and the
+        # quorum flow survives the kill: at least 3 of the 4 survivors
+        # complete all rounds and converge.
+        finished = 0
+        for k, out in enumerate(outs):
+            assert nodes[k].returncode == 0, (
+                f"node {k} failed:\n{out[-2000:]}"
+            )
+            json_lines = [
+                l for l in out.splitlines() if l.startswith("{")
+            ]
+            assert json_lines, f"node {k} printed no summary:\n{out[-1500:]}"
+            summary = json.loads(json_lines[-1])
+            if summary["steps"] == n_iter:
+                assert summary["final_accuracy"] > max(
+                    0.3, first_acc + 0.1
+                ), f"node {k} finished but did not converge: {summary}"
+                finished += 1
+        assert finished >= 3, (
+            f"only {finished}/4 survivors completed all {n_iter} rounds"
+        )
+    finally:
+        watchdog.cancel()
+        for p in nodes:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_cluster_batchnorm_stats_travel(tmp_path):
+    """SSMW BN-stat exchange (VERDICT r3 weak #5): on a BatchNorm model the
+    gradient frames carry [grad || batch_stats] and the model frames
+    [params || mean stats]; the strict frame-length contracts on both ends
+    make a clean 4-iter run the proof that the extended layout round-trips
+    (any mismatch raises/excludes). regnetx200 is the smallest BN model in
+    the zoo (2.3M params, 21k stats)."""
+    n_w = 2
+    cfg_path, env = _cluster_setup(tmp_path, n_w)
+    extra = (
+        "--dataset", "cifar10", "--model", "regnetx200", "--batch", "8",
+        "--fw", "0", "--gar", "average", "--num_iter", "4",
+        "--train_size", "64", "--acc_freq", "0",
+    )
+    ps = _launch("ps:0", cfg_path, env, extra=extra)
+    workers = [
+        _launch(f"worker:{w}", cfg_path, env, extra=extra)
+        for w in range(n_w)
+    ]
+    try:
+        out, _ = ps.communicate(timeout=500)
+        assert ps.returncode == 0, f"PS failed:\n{out[-2000:]}"
+        summary = json.loads(
+            [l for l in out.splitlines() if l.startswith("{")][-1]
+        )
+        assert summary["steps"] == 4
+        for w in workers:
+            wout, _ = w.communicate(timeout=200)
+            assert w.returncode == 0, f"worker failed:\n{wout[-1500:]}"
+            wsummary = json.loads(
+                [l for l in wout.splitlines() if l.startswith("{")][-1]
+            )
+            assert wsummary["steps"] == 4
+    finally:
+        for p in [ps, *workers]:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_cluster_momentum_cclip_defense_vs_lie(tmp_path):
+    """The headline defense against the attack that motivated it, with a
+    REAL process running the attack: the Byzantine worker computes its
+    2-member cohort's honest momenta locally from its own batches
+    (byzWorker.py:114-125 local-cohort trick) and publishes mu + z*sigma
+    each step; cclip over the q = 4 fastest of 5 EMAs must still converge.
+    Config is the TTA-proven stable pairing (wm 0.9 + plain-SGD server +
+    lr 0.2 — see BASELINE.md and the r3 flake anatomy)."""
+    n_w = 5
+    cfg_path, env = _cluster_setup(tmp_path, n_w)
+    n_iter = 400
+    defense = (
+        "--gar", "cclip", "--worker_momentum", "0.9",
+        "--opt_args", '{"lr":"0.2"}', "--num_iter", str(n_iter),
+    )
+    ps = _launch("ps:0", cfg_path, env, extra=defense)
+    workers = [
+        _launch(
+            f"worker:{w}", cfg_path, env,
+            extra=defense + (
+                ("--attack", "lie", "--attack_params", '{"cohort": 2}')
+                if w == n_w - 1 else ()
+            ),
+        )
+        for w in range(n_w)
+    ]
+    _assert_ps_converges(
+        ps, workers, "cclip+momentum did not ride out the lie attacker",
+        steps=n_iter, timeout=400 + 5 * n_iter,
+    )
+
+
 def test_ps_checkpoint_resume(tmp_path):
     """PS-side checkpoint/resume: run 30 steps with checkpointing, then
     relaunch with --resume for 60 — the PS restores step 30 and the
     workers (which always start expecting round 0) catch up to the resumed
-    round via read_latest, finishing the remaining 30 steps."""
+    round via read_latest, finishing the remaining 30 steps. Workers run
+    --worker_momentum, so the resume also exercises the per-worker EMA
+    persistence (ADVICE r3: the EMA is training state; without it a resume
+    re-warms from zero while an attacker keeps full strength)."""
     n_w = 4
     cfg_path, env = _cluster_setup(tmp_path, n_w)
     ckpt_dir = str(tmp_path / "ckpt")
+    # wm 0.9 + plain-SGD server + lr 0.2 is the stable pairing (BASELINE.md)
+    wm = (
+        "--worker_momentum", "0.9", "--opt_args", '{"lr":"0.2"}',
+        "--checkpoint_dir", ckpt_dir, "--checkpoint_freq", "10",
+    )
 
-    def run(extra_ps):
-        ps = _launch("ps:0", cfg_path, env, extra=extra_ps)
+    def run(extra_ps, extra_w=()):
+        ps = _launch("ps:0", cfg_path, env, extra=wm + extra_ps)
         workers = [
-            _launch(f"worker:{w}", cfg_path, env) for w in range(n_w)
+            _launch(f"worker:{w}", cfg_path, env, extra=wm + extra_w)
+            for w in range(n_w)
         ]
         try:
             out, _ = ps.communicate(timeout=400)
             assert ps.returncode == 0, f"PS failed:\n{out[-2000:]}"
+            wouts = []
             for w in workers:
                 wout, _ = w.communicate(timeout=120)
                 assert w.returncode == 0, f"worker failed:\n{wout[-1500:]}"
-            return out
+                wouts.append(wout)
+            return out, wouts
         finally:
             for p in [ps, *workers]:
                 if p.poll() is None:
                     p.kill()
 
-    base = ("--checkpoint_dir", ckpt_dir, "--checkpoint_freq", "10")
-    run(base + ("--num_iter", "30"))
+    run(("--num_iter", "30"))
+    # Every worker persisted its EMA at the checkpoint cadence.
+    import numpy as np
 
-    # Fresh ports for the second generation of processes.
+    for w in range(n_w):
+        with np.load(tmp_path / "ckpt" / f"worker_{w}_mom.npz") as z:
+            assert int(z["step"]) == 30
+            assert np.isfinite(z["mom"]).all() and np.any(z["mom"] != 0)
+
+    # Fresh ports for the second generation of processes. Workers get
+    # --resume too: the EMA restore is gated on it (a NON-resume run with a
+    # stale checkpoint_dir must not silently load old momenta).
     cfg_path, env = _cluster_setup(tmp_path, n_w)
-    out = run(base + ("--resume",))
+    out, wouts = run(("--resume",), extra_w=("--resume",))
     assert "resumed from step 30" in out
+    for w, wout in enumerate(wouts):
+        assert "restored momentum EMA from step 30" in wout, (
+            f"worker {w} did not restore its EMA:\n{wout[-800:]}"
+        )
     summary = json.loads(
         [l for l in out.splitlines() if l.startswith("{")][-1]
     )

@@ -185,3 +185,28 @@ def test_late_joiner_catches_up():
     finally:
         for p in peers:
             p.close()
+
+
+def test_collect_begin_latches_before_overwrite():
+    """Pre-registered waiters (collect_begin) must latch a frame that is
+    later overwritten — the publish-then-collect race a symmetric gossip
+    protocol hits on an oversubscribed host (apps/cluster._run_learn)."""
+    import time
+
+    peers = _mesh(2)
+    try:
+        wait = peers[0].collect_begin(7, q=2, timeout_ms=15_000)
+        time.sleep(0.2)  # waiters blocked on the register
+        peers[1].publish(7, b"frame7")
+        time.sleep(0.2)  # latched by the blocked reader...
+        peers[1].publish(8, b"frame8")  # ...then overwritten in the slot
+        peers[0].publish(7, b"self")
+        got = wait()
+        assert got == {0: b"self", 1: b"frame7"}
+
+        # Control: a collect REGISTERED after the overwrite cannot see 7.
+        with pytest.raises(TimeoutError):
+            peers[0].collect(7, q=1, peers=[1], timeout_ms=300)
+    finally:
+        for p in peers:
+            p.close()
