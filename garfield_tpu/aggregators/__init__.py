@@ -33,7 +33,8 @@ class GAR:
     """
 
     def __init__(self, name, unchecked, check, upper_bound=None, influence=None,
-                 tree_aggregate=None, gram_select=None, fold_aggregate=None):
+                 tree_aggregate=None, gram_select=None, fold_aggregate=None,
+                 tree_aggregate_ext=None):
         self.name = name
         self.unchecked = unchecked
         self.check = check
@@ -59,6 +60,13 @@ class GAR:
         # materializes ``W @ poisoned_stack`` as a stacked tree for any
         # (r, n) weight matrix — phase-2-style reductions then run on it.
         self.fold_aggregate = fold_aggregate
+        # Folded form for coordinate-wise rules (median, tmean):
+        # ``tree_aggregate_ext(ext_tree, row_map, row_scale, **params)``
+        # aggregates the EXTENDED stacked tree (raw rows + the attack's
+        # shared fake row) under a STATIC row remap/scale — the Pallas
+        # kernels apply the remap in-register (ops.coordinate_median's
+        # row_map/row_scale), so the poisoned stack never materializes.
+        self.tree_aggregate_ext = tree_aggregate_ext
 
         def checked(gradients, *args, **kwargs):
             message = check(gradients, *args, **kwargs)
@@ -84,13 +92,15 @@ gars = {}
 
 
 def register(name, unchecked, check, upper_bound=None, influence=None,
-             tree_aggregate=None, gram_select=None, fold_aggregate=None):
+             tree_aggregate=None, gram_select=None, fold_aggregate=None,
+             tree_aggregate_ext=None):
     """Register an aggregation rule (reference __init__.py:71-86)."""
     if name in gars:
         tools.warning(f"GAR {name!r} already registered; overwriting")
     gar = GAR(name, unchecked, check, upper_bound=upper_bound,
               influence=influence, tree_aggregate=tree_aggregate,
-              gram_select=gram_select, fold_aggregate=fold_aggregate)
+              gram_select=gram_select, fold_aggregate=fold_aggregate,
+              tree_aggregate_ext=tree_aggregate_ext)
     gars[name] = gar
     return gar
 

@@ -31,6 +31,21 @@ def tree_aggregate(stacked_tree, key=None, **kwargs):
     return tree_coordinatewise(coordinate_median, stacked_tree)
 
 
+def tree_aggregate_ext(ext_tree, row_map, row_scale, key=None, **kwargs):
+    """Folded-attack twin (parallel/fold.py): per-leaf median over the
+    EXTENDED stacked tree with the attack's static row remap applied
+    in-register by the Pallas kernel — no poisoned stack, no moment
+    passes."""
+    from .. import ops
+
+    return tree_coordinatewise(
+        lambda g: ops.coordinate_median(
+            g, row_map=row_map, row_scale=row_scale
+        ),
+        ext_tree,
+    )
+
+
 def check(gradients, **kwargs):
     if num_gradients(gradients) < 1:
         return f"expected at least one gradient to aggregate, got {gradients!r}"
@@ -43,4 +58,4 @@ def upper_bound(n, f, d):
 
 
 register("median", aggregate, check, upper_bound=upper_bound,
-         tree_aggregate=tree_aggregate)
+         tree_aggregate=tree_aggregate, tree_aggregate_ext=tree_aggregate_ext)

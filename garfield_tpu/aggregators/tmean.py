@@ -35,6 +35,19 @@ def tree_aggregate(stacked_tree, f, key=None, **kwargs):
     return tree_coordinatewise(lambda g: ops.trimmed_mean(g, f), stacked_tree)
 
 
+def tree_aggregate_ext(ext_tree, row_map, row_scale, f, key=None, **kwargs):
+    """Folded-attack twin (parallel/fold.py): per-leaf trimmed mean over
+    the EXTENDED stacked tree, remap applied in-register by the kernel."""
+    from .. import ops
+
+    return tree_coordinatewise(
+        lambda g: ops.trimmed_mean(
+            g, f, row_map=row_map, row_scale=row_scale
+        ),
+        ext_tree,
+    )
+
+
 def check(gradients, f, **kwargs):
     n = num_gradients(gradients)
     if n < 1:
@@ -53,4 +66,4 @@ def upper_bound(n, f, d):
 
 
 register("tmean", aggregate, check, upper_bound=upper_bound,
-         tree_aggregate=tree_aggregate)
+         tree_aggregate=tree_aggregate, tree_aggregate_ext=tree_aggregate_ext)

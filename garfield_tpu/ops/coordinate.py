@@ -112,22 +112,40 @@ def _pad_cols(g, tile):
     return g, d
 
 
-def _load_rows(x_ref, n):
+def _load_rows(x_ref, n, sel=None):
     """Rows upcast to f32 in VMEM: Mosaic on current targets rejects bf16
     compares ("Target does not support this comparison" — caught by the
     on-device tests, tests/test_ops_tpu.py), and bf16 -> f32 is exact and
     order-preserving, so the sort network is unchanged semantically while
-    HBM traffic stays bf16."""
-    return [x_ref[i, :].astype(jnp.float32) for i in range(n)]
+    HBM traffic stays bf16.
+
+    ``sel`` (optional, STATIC): list of (row_index, scale) pairs — the
+    folded-attack remap (parallel/fold.py): logical row i is
+    ``scale * block[row_index]``. Duplicate indices (lie's shared fake
+    row) are free VMEM re-reads; the indexing and scaling unroll at trace
+    time, so the poisoned stack is never materialized anywhere."""
+    if sel is None:
+        return [x_ref[i, :].astype(jnp.float32) for i in range(n)]
+
+    def one(idx, scale):
+        if scale == 0.0:
+            # Exact zeros, not 0*row: the crash attack's where-path writes
+            # literal zero rows, and 0*inf/0*nan would leak NaN into the
+            # sort where the reference semantics have 0.
+            return jnp.zeros_like(x_ref[idx, :], jnp.float32)
+        row = x_ref[idx, :].astype(jnp.float32)
+        return row if scale == 1.0 else row * scale
+
+    return [one(idx, scale) for idx, scale in sel]
 
 
-def _median_kernel(n, x_ref, o_ref):
-    rows = _oddeven_exchange(_load_rows(x_ref, n))
+def _median_kernel(n, sel, x_ref, o_ref):
+    rows = _oddeven_exchange(_load_rows(x_ref, n, sel))
     o_ref[0, :] = rows[(n - 1) // 2].astype(o_ref.dtype)
 
 
-def _tmean_kernel(n, f, x_ref, o_ref):
-    rows = _oddeven_exchange(_load_rows(x_ref, n))
+def _tmean_kernel(n, f, sel, x_ref, o_ref):
+    rows = _oddeven_exchange(_load_rows(x_ref, n, sel))
     acc = rows[f]
     for i in range(f + 1, n - f):
         acc = acc + rows[i]
@@ -242,31 +260,93 @@ def _dispatch(g, kernel, fallback_fn, tile, interpret, n, op):
     )
 
 
-def coordinate_median(g, *, interpret=False, tile=_TILE):
-    """Lower coordinate-wise median of an (n, d) stack -> (d,)."""
+def _remap_sel(g, row_map, row_scale):
+    """Normalize the folded-attack remap to a static ``sel`` list (or None)
+    plus the logical row count; validates bounds against g's physical rows.
+    ``row_map``/``row_scale`` must be concrete (numpy) — the remap is baked
+    into the kernel at trace time."""
+    import numpy as np
+
+    if row_map is None and row_scale is None:
+        return None, g.shape[0]
+    ne = g.shape[0]
+    row_map = (
+        np.arange(ne) if row_map is None else np.asarray(row_map, np.int64)
+    )
+    n = row_map.size
+    row_scale = (
+        np.ones(n) if row_scale is None else np.asarray(row_scale, np.float64)
+    )
+    if row_scale.size != n:
+        raise ValueError(
+            f"row_scale has {row_scale.size} entries for {n} mapped rows"
+        )
+    if row_map.min() < 0 or row_map.max() >= ne:
+        raise ValueError(
+            f"row_map references rows outside the {ne}-row stack"
+        )
+    return [
+        (int(i), float(s)) for i, s in zip(row_map, row_scale)
+    ], n
+
+
+def _remap_fallback(g, sel):
+    """XLA form of the remap: one static gather + row scaling. Zero scales
+    produce exact zero rows (see ``_load_rows``: 0*inf must not leak NaN
+    where the where-path's crash attack writes literal zeros)."""
+    import numpy as np
+
+    idx = jnp.asarray(np.array([i for i, _ in sel]))
+    scale_np = np.array([s for _, s in sel])
+    scale = jnp.asarray(scale_np, g.dtype)
+    eff = g[idx] * scale[:, None]
+    zero = scale_np == 0.0
+    if zero.any():
+        eff = jnp.where(jnp.asarray(zero)[:, None], 0.0, eff).astype(eff.dtype)
+    return eff
+
+
+def coordinate_median(g, *, row_map=None, row_scale=None, interpret=False,
+                      tile=_TILE):
+    """Lower coordinate-wise median of an (n, d) stack -> (d,).
+
+    ``row_map``/``row_scale`` (static) apply the folded-attack remap INSIDE
+    the kernel — logical row i is ``row_scale[i] * g[row_map[i]]`` — so the
+    poisoned stack of a deterministic attack is never materialized
+    (parallel/fold.py)."""
     g = jnp.asarray(g)
-    n = g.shape[0]
+    sel, n = _remap_sel(g, row_map, row_scale)
     if n == 1:
-        return g[0]
+        return g[0] if sel is None else _remap_fallback(g, sel)[0]
+    fallback = (
+        coordinate_median_reference if sel is None
+        else lambda a: coordinate_median_reference(_remap_fallback(a, sel))
+    )
     return _dispatch(
-        g, functools.partial(_median_kernel, n),
-        coordinate_median_reference, tile, interpret,
+        g, functools.partial(_median_kernel, n, sel),
+        fallback, tile, interpret,
         n, "coordinate_median",
     )
 
 
-def trimmed_mean(g, f, *, interpret=False, tile=_TILE):
+def trimmed_mean(g, f, *, row_map=None, row_scale=None, interpret=False,
+                 tile=_TILE):
     """Coordinate-wise trimmed mean: average of rows f..n-f-1 per sorted
-    column, fused into the sorting-network kernel (one HBM pass)."""
+    column, fused into the sorting-network kernel (one HBM pass).
+    ``row_map``/``row_scale``: see ``coordinate_median``."""
     g = jnp.asarray(g)
-    n = g.shape[0]
+    sel, n = _remap_sel(g, row_map, row_scale)
     if not (0 <= f and n - 2 * f >= 1):
         raise ValueError(f"need n - 2f >= 1, got n={n}, f={f}")
     if n == 1:
-        return g[0]
+        return g[0] if sel is None else _remap_fallback(g, sel)[0]
+    fallback = (
+        (lambda a: trimmed_mean_reference(a, f)) if sel is None
+        else (lambda a: trimmed_mean_reference(_remap_fallback(a, sel), f))
+    )
     return _dispatch(
-        g, functools.partial(_tmean_kernel, n, f),
-        lambda a: trimmed_mean_reference(a, f), tile, interpret,
+        g, functools.partial(_tmean_kernel, n, f, sel),
+        fallback, tile, interpret,
         n, "trimmed_mean",
     )
 

@@ -185,8 +185,10 @@ def make_trainer(
         raise ValueError(f"unknown attack {attack!r}")
     if byz_mask is None:
         byz_mask = core.default_byz_mask(num_workers, f if attack else 0)
-    # Folded attack plan: static for deterministic attacks on Gram-form
-    # rules; None keeps the where-path (fold.plan_for).
+    # Folded attack plan: static for deterministic attacks on
+    # fold-capable rules (Gram-form krum/average/bulyan; coordinate-wise
+    # median/tmean via remapped-row kernels); None keeps the where-path
+    # (fold.plan_for).
     fold_plan = fold.plan_for(gar, attack, byz_mask, attack_params)
     byz_mask = jnp.asarray(byz_mask, dtype=bool)
 

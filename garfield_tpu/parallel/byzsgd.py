@@ -149,7 +149,7 @@ def make_trainer(
     if byz_ps_mask is None:
         byz_ps_mask = core.default_byz_mask(num_ps, fps if ps_attack else 0)
     # Folded attack plan for the gradient phase: static for deterministic
-    # attacks on Gram-form rules; None -> where-path (fold.plan_for).
+    # attacks on fold-capable rules (see fold.plan_for); None -> where-path.
     fold_plan = fold.plan_for(gar, attack, byz_worker_mask, attack_params)
     byz_worker_mask = jnp.asarray(byz_worker_mask, bool)
     byz_ps_mask = jnp.asarray(byz_ps_mask, bool)

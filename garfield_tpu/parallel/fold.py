@@ -30,9 +30,17 @@ contiguous DUS, flat-path algebraic folding) all measured within noise of
 each other (PERF.md).
 
 Applies when the topology's tree path is eligible, the attack is
-deterministic (lie/empire/reverse/crash), and the rule exposes
-``gram_select`` (krum, average). Randomized attacks (random/drop) and
-coordinate-wise rules keep the ``where`` tree path.
+deterministic (lie/empire/reverse/crash), and the rule exposes a
+fold-capable interface: ``gram_select`` (krum, average),
+``fold_aggregate`` (Bulyan), or ``tree_aggregate_ext`` (the
+coordinate-wise median/tmean — their Pallas kernels apply the row
+remap/scale in-register, ops/coordinate.py). Randomized attacks
+(random/drop) and cclip keep the ``where`` tree path. Known corner for
+the Gram-form rules: with NON-FINITE raw gradients in a crash-attacked
+row, the folded Gram gets 0*inf = NaN entries (treated as infinitely
+distant) where the where-path's literal zero row is a finite candidate —
+selection may differ in that pathological regime (the coordinate-wise
+kernels special-case zero scales to exact zeros instead).
 """
 
 import jax
@@ -47,12 +55,13 @@ __all__ = ["plan_for", "folded_tree_aggregate"]
 
 def plan_for(gar, attack, byz_mask, attack_params):
     """Single-sourced fold eligibility gate for the topology builders
-    (aggregathor AND byzsgd): a plan exists iff the rule has a Gram form
-    (``gram_select`` or ``fold_aggregate``) and the attack folds
-    (deterministic, with actual Byzantine slots, and GARFIELD_NO_FOLD
-    unset). ``byz_mask`` may be any array-like; it must be concrete (the
-    plan is static)."""
-    if gar.gram_select is None and gar.fold_aggregate is None:
+    (aggregathor AND byzsgd): a plan exists iff the rule has a fold-capable
+    form (``gram_select``, ``fold_aggregate``, or the coordinate-wise
+    ``tree_aggregate_ext``) and the attack folds (deterministic, with
+    actual Byzantine slots, and GARFIELD_NO_FOLD unset). ``byz_mask`` may
+    be any array-like; it must be concrete (the plan is static)."""
+    if (gar.gram_select is None and gar.fold_aggregate is None
+            and gar.tree_aggregate_ext is None):
         return None
     return plan_gradient_attack_fold(
         attack, np.asarray(byz_mask, dtype=bool), **attack_params
@@ -90,12 +99,9 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
     """
     leaves, treedef = jax.tree.flatten(stacked_tree)
     n = leaves[0].shape[0]
-    rmap = plan.row_map
-    scale = jnp.asarray(plan.row_scale)
-    scale_outer = scale[:, None] * scale[None, :]
     params = gar_params or {}
 
-    if gar.gram_select is not None:
+    if gar.gram_select is not None or gar.tree_aggregate_ext is not None:
         ext = stacked_tree
         if plan.build_extra is not None:
             extra = plan.build_extra(stacked_tree)
@@ -103,6 +109,16 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
                 lambda l, e: jnp.concatenate([l, e[None]], axis=0),
                 stacked_tree, extra,
             )
+        if gar.gram_select is None:
+            # Coordinate-wise rules (median, tmean): per-leaf kernels with
+            # the remap applied in-register — no poisoned stack, no
+            # cohort-moment passes outside the fake-row build.
+            return gar.tree_aggregate_ext(
+                ext, plan.row_map, plan.row_scale, f=f, key=key, **params
+            )
+        rmap = plan.row_map
+        scale = jnp.asarray(plan.row_scale)
+        scale_outer = scale[:, None] * scale[None, :]
         gram = tree_gram(ext)  # (n+k, n+k), fuses into the backward like f=0
         gram_p = gram[rmap][:, rmap] * scale_outer
         w = gar.gram_select(gram_p, f=f, key=key, **params)
@@ -113,6 +129,9 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
     # fold_aggregate rules: flat-block layout.
     from ..aggregators._common import concat_stack, unflatten_vec
 
+    rmap = plan.row_map
+    scale = jnp.asarray(plan.row_scale)
+    scale_outer = scale[:, None] * scale[None, :]
     stack, shapes = concat_stack(leaves)
     acc = jnp.promote_types(stack.dtype, jnp.float32)
     gram = jnp.matmul(stack, stack.T, preferred_element_type=acc)

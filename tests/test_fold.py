@@ -57,10 +57,13 @@ class TestFoldPlans:
 
 
 class TestFoldedAggregate:
-    # bulyan (n >= 4f+3) runs at f=1; it exercises the fold_aggregate branch
-    # (weight-MATRIX apply_rows), the others the gram_select branch.
+    # bulyan (n >= 4f+3) runs at f=1 and exercises the fold_aggregate
+    # branch (weight-MATRIX apply_rows); krum/average the gram_select
+    # branch; median/tmean the coordinate-wise tree_aggregate_ext branch
+    # (remapped-row kernels).
     @pytest.mark.parametrize("gar_name,f", [
         ("krum", F), ("average", F), ("bulyan", 1),
+        ("median", F), ("tmean", F),
     ])
     @pytest.mark.parametrize("attack", ["lie", "empire", "reverse", "crash"])
     def test_matches_where_path(self, gar_name, f, attack):
@@ -138,3 +141,26 @@ class TestFoldedAggregate:
             np.asarray(w @ g), np.asarray(gars["krum"].unchecked(g, f=F)),
             rtol=1e-5, atol=1e-6,
         )
+
+
+def test_crash_fold_nonfinite_row_stays_zero():
+    """A crashed slot whose raw gradient overflowed (inf) must contribute
+    EXACT zeros through the folded coordinate-wise kernels (0*inf would be
+    NaN; the where-path writes literal zero rows)."""
+    mask = core.default_byz_mask(N, 1)
+    tree = _stacked_tree(jax.random.PRNGKey(13))
+    tree = jax.tree.map(
+        lambda l: l.at[N - 1].set(jnp.inf), tree
+    )
+    plan = plan_gradient_attack_fold("crash", mask)
+    got = folded_tree_aggregate(gars["median"], plan, tree, f=1)
+    poisoned = apply_gradient_attack_tree("crash", tree, jnp.asarray(mask))
+    want = gars["median"].tree_aggregate(poisoned, f=1)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        got, want,
+    )
+    for leaf in jax.tree.leaves(got):
+        assert np.isfinite(np.asarray(leaf)).all()

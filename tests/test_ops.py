@@ -211,3 +211,39 @@ def test_large_n_fallback_warns_only_on_tpu_backend(monkeypatch):
         coordinate.use_pallas(
             coordinate.MAX_SORT_N + 1, op="coordinate_median"
         )
+
+
+@pytest.mark.parametrize("op", ["median", "tmean"])
+def test_remap_kernel_matches_materialized(op):
+    """row_map/row_scale (the folded-attack remap, parallel/fold.py) applied
+    in-register must equal materializing the remapped stack first —
+    including a duplicated fake row (lie) and a scaled row (reverse)."""
+    ext = _rand(9, 300, seed=11)  # 8 raw rows + 1 fake row
+    row_map = np.array([0, 1, 2, 3, 4, 5, 8, 8])  # byz rows 6,7 -> fake
+    row_scale = np.array([1.0, 1.0, 1.0, 1.0, 1.0, -100.0, 1.0, 1.0])
+    eff = ext[row_map] * row_scale[:, None].astype(np.float32)
+    if op == "median":
+        got = coordinate.coordinate_median(
+            ext, row_map=row_map, row_scale=row_scale,
+            interpret=True, tile=128,
+        )
+        want = coordinate.coordinate_median_reference(jnp.asarray(eff))
+    else:
+        got = coordinate.trimmed_mean(
+            ext, 2, row_map=row_map, row_scale=row_scale,
+            interpret=True, tile=128,
+        )
+        want = coordinate.trimmed_mean_reference(jnp.asarray(eff), 2)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_remap_validates_bounds():
+    x = _rand(4, 16, seed=3)
+    with pytest.raises(ValueError):
+        coordinate.coordinate_median(x, row_map=[0, 1, 2, 9])
+    with pytest.raises(ValueError):
+        coordinate.coordinate_median(
+            x, row_map=[0, 1], row_scale=[1.0, 1.0, 1.0]
+        )

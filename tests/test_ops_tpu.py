@@ -71,3 +71,20 @@ def test_avgmed_on_device(s, beta, dtype):
     )
     tol = 2e-2 if dtype == jnp.bfloat16 else 1e-6
     np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
+
+def test_remap_kernel_on_device():
+    """Folded-attack remap (row_map/row_scale) inside the Mosaic-lowered
+    kernel: duplicated fake row + scaled row vs materialized remap."""
+    ext = _rand(9, 2048, seed=21, dtype=jnp.bfloat16)
+    row_map = np.array([0, 1, 2, 3, 4, 5, 8, 8])
+    row_scale = np.array([1.0] * 5 + [-100.0, 1.0, 1.0])
+    eff = (np.asarray(ext, np.float32)[row_map]
+           * row_scale[:, None]).astype(np.float32)
+    got = np.asarray(coordinate.coordinate_median(
+        jnp.asarray(ext), row_map=row_map, row_scale=row_scale
+    ), np.float32)
+    want = np.asarray(coordinate.coordinate_median_reference(
+        jnp.asarray(eff, jnp.float32)
+    ), np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)
