@@ -146,6 +146,32 @@ def _host_model_attack(name, params):
     )
 
 
+def _robust_stats(rows, f):
+    """Coordinate-wise trimmed mean of worker-supplied BatchNorm-statistic
+    rows under the deployment's f budget (ADVICE r4 medium).
+
+    A Byzantine PROCESS controls its wire bytes, so the BN segment of its
+    gradient frame is attacker-chosen regardless of the gradient GAR; a
+    plain mean would hand it an unbounded write path into every honest
+    worker's normalizer — a poisoning channel the reference never opens
+    (its RPC plane ships gradients only; BN stays local). Trimming the f
+    smallest and f largest values per coordinate bounds the influence of up
+    to f Byzantine rows PROVIDED q >= 2f + 1 (the stats analog of tmean);
+    at f=0 this IS the plain mean the on-mesh path computes
+    (core.mean_model_state — where stats are honestly computed by
+    construction, so no trim is needed). When q < 2f + 1 the trim clamps
+    to the coordinate-wise median — the best available estimator, but a
+    quorum whose Byzantine members can be the majority (n_w <= 3f) is
+    indefensible for stats and _run_ps warns about it once at startup.
+    """
+    q = rows.shape[0]
+    t = min(int(f), (q - 1) // 2)
+    if t == 0:
+        return np.mean(rows, axis=0).astype(np.float32)
+    s = np.sort(rows, axis=0)
+    return np.mean(s[t:q - t], axis=0).astype(np.float32)
+
+
 def _setup(args):
     """Shared ingredients for both roles."""
     cfg = multihost.ClusterConfig(args.cluster)
@@ -157,18 +183,43 @@ def _setup(args):
     if n_ps < 1:
         raise SystemExit("cluster config needs at least one PS host")
     if n_ps > 1:
-        # MSMW (ByzSGD): the fps-tolerant model plane needs the model GAR's
-        # contract to hold over the n_ps gathered models.
-        model_gar_name = getattr(args, "model_gar", None) or args.gar
-        fps = getattr(args, "fps", 0)
-        msg = gars[model_gar_name].check(
-            np.zeros((n_ps, 4), np.float32), f=fps,
-        ) if fps else None
-        if msg is not None:
+        # MSMW (ByzSGD): only the byzsgd app can parameterize the
+        # fps-tolerant model plane (--model_gar/--ps_attack are its flags;
+        # --fps alone lives in the shared base parser, so its presence
+        # distinguishes nothing) — an aggregathor config with several PS
+        # hosts must fail loudly, not silently enter the MSMW path.
+        if not hasattr(args, "model_gar"):
             raise SystemExit(
-                f"model GAR {model_gar_name!r} cannot aggregate the "
-                f"{n_ps} PS models at fps={fps}: {msg}"
+                f"the cluster config has {n_ps} PS hosts but this app has "
+                "no --model_gar/--ps_attack support; launch MSMW "
+                "deployments through the byzsgd app (or use a single-PS "
+                "config)"
             )
+        model_gar_name = args.model_gar or args.gar
+        model_gar = gars[model_gar_name]
+        fps = args.fps
+        if fps:
+            msg = model_gar.check(np.zeros((n_ps, 4), np.float32), f=fps)
+            if msg is not None:
+                raise SystemExit(
+                    f"model GAR {model_gar_name!r} cannot aggregate the "
+                    f"{n_ps} PS models at fps={fps}: {msg}"
+                )
+        else:
+            # fps=0: most rules' check() rejects f=0 outright even though
+            # unchecked() is well-defined there (krum at f=0 still selects
+            # m = n - 2), so checking would break valid fps=0 deployments.
+            # Instead probe the EXACT runtime call on a dummy stack — an
+            # infeasible (rule, n_ps) pair (ADVICE r4: krum over n_ps=2
+            # gives m = 0) fails loudly here instead of as an opaque
+            # ZeroDivisionError at trace time.
+            try:
+                model_gar.unchecked(np.zeros((n_ps, 4), np.float32), f=0)
+            except Exception as e:  # noqa: BLE001 — any trace failure
+                raise SystemExit(
+                    f"model GAR {model_gar_name!r} cannot aggregate the "
+                    f"{n_ps} PS models at fps=0: {type(e).__name__}: {e}"
+                ) from e
     n_w = len(cfg.workers)
     f = args.fw
     q = n_w - f
@@ -298,14 +349,16 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
 
     BatchNorm statistics travel too (VERDICT r3 weak #5): each worker's
     gradient frame carries its updated flat ``batch_stats`` appended after
-    the gradient, the PS MEANS the quorum's stats (exactly what the
-    on-mesh path does, core.mean_model_state) and appends the mean to the
-    published model frame — so the two deployment shapes of the SSMW
+    the gradient, the PS aggregates the quorum's stats with a coordinate-
+    wise f-trimmed mean (``_robust_stats`` — a real Byzantine process
+    controls the BN segment of its frame, so the aggregation must carry
+    the same f budget as the gradients; at f=0 it reduces to the plain
+    mean of the on-mesh core.mean_model_state) and appends the result to
+    the published model frame — so the two deployment shapes of the SSMW
     topology converge to the same model on BN architectures instead of
-    the reference's silent local-BN drift. Caveat shared with the on-mesh
-    path: the mean is NOT a robust aggregation — BN statistics are outside
-    the GAR's protection in the reference design too (only gradients are
-    defended). Stat-less models (d_bn = 0) keep byte-identical frames.
+    the reference's silent local-BN drift (at f>0 the trim makes the two
+    shapes agree only statistically, the price of robustness). Stat-less
+    models (d_bn = 0) keep byte-identical frames.
     """
     from .. import parallel
 
@@ -315,6 +368,14 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
     bn0_flat, bn_unravel = ravel_pytree(ms0)
     bn_bytes = int(np.asarray(bn0_flat).size) * 4
     bn_mean = np.asarray(bn0_flat, np.float32)
+    if bn_bytes and f and q < 2 * f + 1:
+        tools.warning(
+            f"BN-stat aggregation: the quorum q={q} is below 2*fw+1="
+            f"{2 * f + 1}, so the f-trimmed mean clamps to the coordinate-"
+            "wise median — if all fw Byzantine workers land in one quorum "
+            "they are its majority and can steer the BN statistics "
+            "(n_w <= 3*fw is indefensible for stats; see _robust_stats)"
+        )
     test_batches = parallel.EvalSet(
         test_batches, binary=args.dataset == "pima"
     )
@@ -404,11 +465,12 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
         ]
         rows = [fr[: flat.size] for fr in frames]
         if bn_bytes:
-            # Mean of the quorum's BatchNorm stats — what the on-mesh path
-            # computes with core.mean_model_state (NOT robust; see above).
-            bn_mean = np.mean(
-                np.stack([fr[flat.size:] for fr in frames]), axis=0
-            ).astype(np.float32)
+            # Robust coordinate-wise aggregation of the quorum's BatchNorm
+            # stats (trim f per side; plain mean at f=0 == the on-mesh
+            # core.mean_model_state) — see _robust_stats.
+            bn_mean = _robust_stats(
+                np.stack([fr[flat.size:] for fr in frames]), f
+            )
         flat_dev, opt_state = ps_update(
             flat_dev, opt_state, jnp.asarray(np.stack(rows)),
             jnp.asarray(i, jnp.int32),
@@ -555,6 +617,7 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
 
     t0 = time.time()
     flat = np.asarray(flat0, np.float32)
+    flat_dev = jnp.asarray(flat)  # --num_iter 0: eval the init model
     d_bytes = flat.size * 4
     good_ranks = list(worker_ranks)
     everyone = [r for r in ps_ranks if r != ex.my_index] + list(worker_ranks)
@@ -709,21 +772,35 @@ def _run_learn(args):
         )
 
     def harvest(wait_fn, payload_np):
-        """Drain a pre-registered quorum, stack the q lowest-rank rows.
-        Malformed frames (Byzantine wire bytes) become zero rows — a
-        crash-like value fault inside the f budget."""
+        """Drain a pre-registered quorum, stack the q lowest-rank
+        WELL-FORMED rows. Malformed frames (Byzantine wire bytes) are
+        filtered FIRST, so an extra well-formed frame from a higher rank
+        replaces a malformed lower one (ADVICE r4: discarding honest data
+        while feeding the GAR substitute zeros would hand the attacker a
+        second fault for free); zero rows — a crash-like value fault
+        inside the f budget — pad only when fewer than q well-formed
+        frames exist."""
         got = wait_fn()
         d_bytes = payload_np.size * 4
-        rows = [
-            np.frombuffer(got[k], np.float32)
-            for k in sorted(got)[:q]
-            if len(got[k]) == d_bytes
-        ]
+        well_formed = []
+        for k in sorted(got):
+            if len(got[k]) == d_bytes:
+                well_formed.append(k)
+            elif k not in warned_malformed:  # once per peer, not per round
+                warned_malformed.add(k)
+                tools.warning(
+                    f"[{who}] peer rank {k} sent a malformed "
+                    f"{len(got[k])}-byte frame (expected {d_bytes}); "
+                    "dropping its malformed frames from every quorum "
+                    "(warned once)"
+                )
+        rows = [np.frombuffer(got[k], np.float32) for k in well_formed[:q]]
         while len(rows) < q:
             rows.append(np.zeros(payload_np.size, np.float32))
         return np.stack(rows)
 
     who = f"cluster-node-{me}"
+    warned_malformed = set()
     t0 = time.time()
     base_key = jax.random.PRNGKey(args.seed + 1 + me)
     flat = np.asarray(flat0, np.float32)

@@ -35,12 +35,16 @@ fold-capable interface: ``gram_select`` (krum, average),
 ``fold_aggregate`` (Bulyan), or ``tree_aggregate_ext`` (the
 coordinate-wise median/tmean — their Pallas kernels apply the row
 remap/scale in-register, ops/coordinate.py). Randomized attacks
-(random/drop) and cclip keep the ``where`` tree path. Known corner for
-the Gram-form rules: with NON-FINITE raw gradients in a crash-attacked
-row, the folded Gram gets 0*inf = NaN entries (treated as infinitely
-distant) where the where-path's literal zero row is a finite candidate —
-selection may differ in that pathological regime (the coordinate-wise
-kernels special-case zero scales to exact zeros instead).
+(random/drop) and cclip keep the ``where`` tree path. Zero-scale rows
+(the crash attack) are sanitized everywhere a 0*inf could otherwise
+produce NaN: the remapped Gram's zero-scale rows/cols are forced to
+exact zeros (matching the where-path's literal zero row, whose inner
+products are exactly 0 even when the raw gradient is non-finite), the
+weighted sums already mask zero-weight rows (``tree_weighted_sum`` /
+``apply_rows``'s ``used`` guard), and the coordinate-wise kernels
+special-case zero scales in-register — so folded selection equals
+where-path selection even with non-finite raw gradients (ADVICE r4;
+asserted in tests/test_fold.py).
 """
 
 import jax
@@ -101,6 +105,19 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
     n = leaves[0].shape[0]
     params = gar_params or {}
 
+    def sanitize_gram(gram_p):
+        """Force zero-scale (crash) rows/cols of the remapped Gram to exact
+        zeros. scale==0 means the poisoned row IS the zero vector, whose
+        inner products are exactly 0 — but 0 * inf = NaN if the raw row the
+        remap points at is non-finite, which the where-path cannot produce
+        (its literal zero row dots finitely). Static no-op when no scale is
+        zero, so lie/empire/reverse pay nothing."""
+        zero = np.asarray(plan.row_scale) == 0
+        if not zero.any():
+            return gram_p
+        zmask = jnp.asarray(zero)
+        return jnp.where(zmask[:, None] | zmask[None, :], 0.0, gram_p)
+
     if gar.gram_select is not None or gar.tree_aggregate_ext is not None:
         ext = stacked_tree
         if plan.build_extra is not None:
@@ -120,7 +137,7 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
         scale = jnp.asarray(plan.row_scale)
         scale_outer = scale[:, None] * scale[None, :]
         gram = tree_gram(ext)  # (n+k, n+k), fuses into the backward like f=0
-        gram_p = gram[rmap][:, rmap] * scale_outer
+        gram_p = sanitize_gram(gram[rmap][:, rmap] * scale_outer)
         w = gar.gram_select(gram_p, f=f, key=key, **params)
         w = w.astype(jnp.float32) * scale
         w_ext = jnp.zeros((n + plan.num_extra,), jnp.float32).at[rmap].add(w)
@@ -147,7 +164,7 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
             jnp.concatenate([gram, c[:, None]], axis=1),
             jnp.concatenate([c[None, :], aa[None, None]], axis=1),
         ], axis=0)  # (n+1, n+1), no (n+1, d) array ever built
-    gram_p = gram[rmap][:, rmap] * scale_outer
+    gram_p = sanitize_gram(gram[rmap][:, rmap] * scale_outer)
 
     def apply_rows(W):
         """(r, n) selection weights -> (W @ poisoned_stack, unflatten)."""

@@ -111,6 +111,26 @@ def _assert_ps_converges(ps, workers, tag, steps=60, timeout=400):
                 p.kill()
 
 
+def test_robust_stats_trims_byzantine_row():
+    """The BN-stat plane carries the f budget (ADVICE r4 medium): a
+    Byzantine process's arbitrary stat row must not leak through the
+    aggregation; f=0 stays the plain on-mesh mean."""
+    import numpy as np
+
+    from garfield_tpu.apps.cluster import _robust_stats
+
+    rng = np.random.default_rng(0)
+    honest = rng.normal(size=(5, 7)).astype(np.float32)
+    byz = np.full((1, 7), 1e9, np.float32)
+    out = _robust_stats(np.concatenate([honest, byz]), f=1)
+    assert np.abs(out).max() < 10.0
+    np.testing.assert_allclose(
+        _robust_stats(honest, 0), honest.mean(axis=0), rtol=1e-6
+    )
+    one = np.ones((1, 3), np.float32)  # trim clamps; never empties
+    np.testing.assert_allclose(_robust_stats(one, 5), one[0])
+
+
 def test_byzantine_worker_process_tolerated(tmp_path):
     """A REAL Byzantine process (not an on-mesh emulation): worker 3 runs
     with --attack reverse (publishes -100x its gradient, byzWorker.py

@@ -143,19 +143,28 @@ class TestFoldedAggregate:
         )
 
 
-def test_crash_fold_nonfinite_row_stays_zero():
-    """A crashed slot whose raw gradient overflowed (inf) must contribute
-    EXACT zeros through the folded coordinate-wise kernels (0*inf would be
-    NaN; the where-path writes literal zero rows)."""
+@pytest.mark.parametrize("gar_name,f", [
+    ("median", 1), ("tmean", 1),      # coordinate-wise kernels
+    ("krum", 1), ("average", 1),      # gram_select (sanitized Gram)
+    ("bulyan", 1),                    # fold_aggregate (sanitized Gram)
+])
+def test_crash_fold_nonfinite_row_stays_zero(gar_name, f):
+    """A crashed slot whose raw gradient overflowed (inf) must behave as
+    the where-path's literal ZERO row through every folded form: the
+    coordinate-wise kernels special-case zero scales in-register, and the
+    Gram-form rules sanitize the remapped Gram's zero-scale rows/cols
+    (0 * inf would otherwise be NaN and read as infinitely distant,
+    changing selection — ADVICE r4)."""
+    gar = gars[gar_name]
     mask = core.default_byz_mask(N, 1)
     tree = _stacked_tree(jax.random.PRNGKey(13))
     tree = jax.tree.map(
         lambda l: l.at[N - 1].set(jnp.inf), tree
     )
     plan = plan_gradient_attack_fold("crash", mask)
-    got = folded_tree_aggregate(gars["median"], plan, tree, f=1)
+    got = folded_tree_aggregate(gar, plan, tree, f=f)
     poisoned = apply_gradient_attack_tree("crash", tree, jnp.asarray(mask))
-    want = gars["median"].tree_aggregate(poisoned, f=1)
+    want = gar.tree_aggregate(poisoned, f=f)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
