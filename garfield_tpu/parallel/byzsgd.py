@@ -35,6 +35,7 @@ SSMW or LEARN topologies, which match the paper's setting.
 """
 
 import functools
+import os as _os
 
 import jax
 import jax.numpy as jnp
@@ -155,6 +156,14 @@ def make_trainer(
     byz_ps_mask = jnp.asarray(byz_ps_mask, bool)
 
     init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
+    # Slot-fused gradient twin (models/slotfused.py) — worker slots share
+    # one model here, so the fused fwd/dx + per-slot dw formulation applies
+    # exactly as in aggregathor (LEARN cannot use it: per-NODE params).
+    slot_fused_fn = None
+    if per_w > 1 and not _os.environ.get("GARFIELD_NO_SLOTFUSED"):
+        from ..models import slotfused
+
+        slot_fused_fn = slotfused.build_slot_grad_fn(module, loss_fn)
     repl = NamedSharding(mesh, P())
     ps_sharding = NamedSharding(mesh, P(ps_axis))
     # True subsets force the flat path (dynamic per-leaf gathers measured
@@ -224,7 +233,8 @@ def make_trainer(
                 )
             )(slot_ids)
             g, (loss, ms_out) = core.per_slot_grads(
-                grad_fn, params, ms, x_local, y_local, keys
+                grad_fn, params, ms, x_local, y_local, keys,
+                fused_fn=slot_fused_fn,
             )
             g = core.cast_leaves(g, gar_dtype)
             if tree_ok:

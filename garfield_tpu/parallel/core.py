@@ -167,25 +167,32 @@ import os as _os
 UNROLL_MAX_SLOTS = int(_os.environ.get("GARFIELD_UNROLL_MAX_SLOTS", 16))
 
 
-def per_slot_grads(grad_fn, params, ms, x, y, keys):
+def per_slot_grads(grad_fn, params, ms, x, y, keys, fused_fn=None):
     """Per-slot gradients over a leading logical-slot axis, vmap-compatible.
 
     Returns exactly what ``jax.vmap(grad_fn, in_axes=(None, None, 0, 0, 0))``
-    returns — ``(grads, (loss, ms))`` trees with a leading slot axis — but
-    computed by a Python unroll over the slots when their count is small.
+    returns — ``(grads, (loss, ms))`` trees with a leading slot axis —
+    computed by the fastest available formulation:
 
-    Why: folding n logical workers onto one chip via vmap batches every
-    intermediate into 5-D (slot, batch, H, W, C) tensors, and XLA inserts
-    relayout copies/permuted slices around the ResNet family's convs — a
-    measured 36-63% tax (PERF.md "Known frontier"; 12.9 vs 9.1 ms for the
-    8-worker ResNet-18 gradient stack on the v5e chip). The unroll keeps
-    every subgraph 4-D and batch-minor; XLA schedules the independent
-    per-slot fwd+bwd graphs without the relayouts. lax.scan was measured
-    2.6x worse (sequential small batches), the patches-einsum custom VJP
-    3-6x worse, and raveling each slot inside the unroll 12% worse
-    end-to-end (PERF.md) — the plain unroll + stacked tree is the fix.
+      1. ``fused_fn`` (``models.slotfused.build_slot_grad_fn``) when the
+         topology supplies one: the model runs ONCE on the flat (n*b)
+         batch (fused forward + fused dx), and only the parameter-cotangent
+         contractions are slot-resolved — the r5 hybrid (PERF.md).
+      2. A Python unroll over the slots when their count is small: keeps
+         every subgraph 4-D and batch-minor; XLA schedules the independent
+         per-slot fwd+bwd graphs without relayouts (r2; 12.9 -> 9.1 ms for
+         the 8-worker ResNet-18 stack).
+      3. vmap above UNROLL_MAX_SLOTS — compile time of the unroll grows
+         linearly with slots; the 5-D relayout tax shrinks with n
+         (~19% at n=64, PERF.md r4).
+
+    lax.scan was measured 2.6x worse (sequential small batches), the
+    patches-einsum custom VJP 3-6x worse, and raveling each slot inside
+    the unroll 12% worse end-to-end (PERF.md).
     """
     n = x.shape[0]
+    if fused_fn is not None:
+        return fused_fn(params, ms, x, y, keys)
     if n > UNROLL_MAX_SLOTS:
         return jax.vmap(grad_fn, in_axes=(None, None, 0, 0, 0))(
             params, ms, x, y, keys
