@@ -289,6 +289,13 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
             kwargs["worker_momentum"] = args.worker_momentum
         if getattr(args, "gar_params", None) and "gar_params" in trainer_params:
             kwargs["gar_params"] = args.gar_params
+        if "num_iter" in trainer_params:
+            # Run-length hint for the unroll-vs-vmap amortization choice
+            # (core.slot_path_decision): REMAINING steps from this build
+            # point — crash-schedule events and resumes re-jit mid-run, and
+            # a compile premium only amortizes over the steps the rebuilt
+            # program will actually serve.
+            kwargs["num_iter"] = max(0, args.num_iter - step)
         if sched is not None:
             kwargs["attack"] = "crash"
             kwargs[mask_key] = sched.byz_mask(step, num_slots)

@@ -33,8 +33,9 @@ gradients slot-resolved:
   - scale/bias/bias-like parameters use ``_slot_expand`` (broadcast +
     reshape), whose autodiff transpose is a per-slot segment sum.
 
-The result is bit-compatible per-slot gradients (asserted against the
-unroll path in tests/test_parallel.py) at close to fused cost.
+The result is per-slot gradients equal to the unroll path's (asserted in
+tests/test_slotfused.py — exactly for cifarnet, to deep-net f32
+reassociation tolerance for the BN families) at close to fused cost.
 
 These are functional TWINS of the flax zoo modules (resnet.py / nets.py's
 Cifarnet): they consume the exact flax param/batch_stats trees by name, so

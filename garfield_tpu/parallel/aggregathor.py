@@ -25,7 +25,6 @@ topology with num_workers=1, f=0, gar="average", attack=None.
 """
 
 import functools
-import os as _os
 
 import jax
 import jax.numpy as jnp
@@ -116,6 +115,7 @@ def make_trainer(
     gar_dtype=None,
     worker_momentum=None,
     gar_params=None,
+    num_iter=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the SSMW topology.
 
@@ -194,14 +194,11 @@ def make_trainer(
     byz_mask = jnp.asarray(byz_mask, dtype=bool)
 
     init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
-    # Slot-fused gradient twin (models/slotfused.py): fused fwd + fused dx,
-    # per-slot dw — used when the model has a twin, more than one logical
-    # slot folds onto a shard, and GARFIELD_NO_SLOTFUSED is unset.
-    slot_fused_fn = None
-    if per_shard > 1 and not _os.environ.get("GARFIELD_NO_SLOTFUSED"):
-        from ..models import slotfused
-
-        slot_fused_fn = slotfused.build_slot_grad_fn(module, loss_fn)
+    # Slot-fused gradient twin (models/slotfused.py) when eligible, else
+    # run-length-aware unroll/vmap (core.select_slot_path).
+    slot_fused_fn, force_unroll = core.select_slot_path(
+        module, loss_fn, per_shard, num_iter, log_tag="aggregathor"
+    )
     repl = NamedSharding(mesh, P())
     shard_w = NamedSharding(mesh, P(axis))
 
@@ -239,7 +236,7 @@ def make_trainer(
         # leaves fuses cleanly.
         grads_local, (loss_local, ms_local) = core.per_slot_grads(
             grad_fn, params, ms, x_local, y_local, drop_keys,
-            fused_fn=slot_fused_fn,
+            fused_fn=slot_fused_fn, force_unroll=force_unroll,
         )
         # Narrow the aggregation pipeline (see make_trainer docstring); the
         # cast fuses into the backward's output writes. No-op when None.

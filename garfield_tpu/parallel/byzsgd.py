@@ -35,7 +35,6 @@ SSMW or LEARN topologies, which match the paper's setting.
 """
 
 import functools
-import os as _os
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +81,7 @@ def make_trainer(
     gar_dtype=None,
     gar_params=None,
     model_gar_params=None,
+    num_iter=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the MSMW topology.
 
@@ -159,11 +159,9 @@ def make_trainer(
     # Slot-fused gradient twin (models/slotfused.py) — worker slots share
     # one model here, so the fused fwd/dx + per-slot dw formulation applies
     # exactly as in aggregathor (LEARN cannot use it: per-NODE params).
-    slot_fused_fn = None
-    if per_w > 1 and not _os.environ.get("GARFIELD_NO_SLOTFUSED"):
-        from ..models import slotfused
-
-        slot_fused_fn = slotfused.build_slot_grad_fn(module, loss_fn)
+    slot_fused_fn, force_unroll = core.select_slot_path(
+        module, loss_fn, per_w, num_iter, log_tag="byzsgd"
+    )
     repl = NamedSharding(mesh, P())
     ps_sharding = NamedSharding(mesh, P(ps_axis))
     # True subsets force the flat path (dynamic per-leaf gathers measured
@@ -234,7 +232,7 @@ def make_trainer(
             )(slot_ids)
             g, (loss, ms_out) = core.per_slot_grads(
                 grad_fn, params, ms, x_local, y_local, keys,
-                fused_fn=slot_fused_fn,
+                fused_fn=slot_fused_fn, force_unroll=force_unroll,
             )
             g = core.cast_leaves(g, gar_dtype)
             if tree_ok:

@@ -166,8 +166,67 @@ import os as _os
 
 UNROLL_MAX_SLOTS = int(_os.environ.get("GARFIELD_UNROLL_MAX_SLOTS", 16))
 
+# Steps at which the unroll's compile-time premium amortizes against its
+# steady-state win over vmap. Both sides scale ~linearly in slots (compile
+# ~2 s/slot premium, win ~0.38 ms/step/slot at ResNet-18 scale, PERF.md
+# r4), so the breakeven is roughly slot-count independent.
+UNROLL_AMORTIZE_STEPS = int(
+    _os.environ.get("GARFIELD_UNROLL_AMORTIZE_STEPS", 6000)
+)
 
-def per_slot_grads(grad_fn, params, ms, x, y, keys, fused_fn=None):
+
+def slot_path_decision(slots, num_iter=None, fused_available=False):
+    """Pick the per-slot gradient formulation (VERDICT r4 #8).
+
+    Returns ``(path, reason)`` with path in {"fused", "unroll", "vmap"}:
+    the slot-fused twin when the model has one (fastest at every n and the
+    cheapest compile); otherwise the unroll below UNROLL_MAX_SLOTS; above
+    the cap, a RUN-LENGTH-aware choice — the unroll's ~2 s/slot compile
+    premium amortizes in ~UNROLL_AMORTIZE_STEPS steps against its ~24%
+    steady-state win (measured n=64, PERF.md r4), so reference-scale runs
+    (100k iters, Aggregathor/run_exp.sh:39-40) take the unroll
+    automatically instead of silently losing it to a static cap.
+    """
+    if fused_available:
+        return "fused", "slot-fused twin (fused fwd/dx, per-slot dw)"
+    if slots <= UNROLL_MAX_SLOTS:
+        return "unroll", f"{slots} slots <= cap {UNROLL_MAX_SLOTS}"
+    if num_iter is not None and num_iter >= UNROLL_AMORTIZE_STEPS:
+        return "unroll", (
+            f"{num_iter} steps amortize the unroll compile premium "
+            f"(breakeven ~{UNROLL_AMORTIZE_STEPS})"
+        )
+    return "vmap", (
+        f"{slots} slots > cap {UNROLL_MAX_SLOTS} and "
+        + (f"{num_iter} steps < breakeven {UNROLL_AMORTIZE_STEPS}"
+           if num_iter is not None else "run length unknown")
+    )
+
+
+def select_slot_path(module, loss_fn, slots, num_iter=None, log_tag=None):
+    """Shared topology-builder front-end to ``slot_path_decision``.
+
+    Builds the slot-fused twin when eligible (slots fold, model has a twin,
+    GARFIELD_NO_SLOTFUSED unset), logs the decision, and returns
+    ``(fused_fn, force_unroll)`` ready to pass to ``per_slot_grads``.
+    """
+    fused_fn = None
+    if slots > 1 and not _os.environ.get("GARFIELD_NO_SLOTFUSED"):
+        from ..models import slotfused
+
+        fused_fn = slotfused.build_slot_grad_fn(module, loss_fn)
+    path, why = slot_path_decision(slots, num_iter, fused_fn is not None)
+    if slots > 1:
+        from ..utils import tools
+
+        tools.info(
+            f"[{log_tag or 'trainer'}] per-slot gradients: {path} ({why})"
+        )
+    return fused_fn, path == "unroll"
+
+
+def per_slot_grads(grad_fn, params, ms, x, y, keys, fused_fn=None,
+                   force_unroll=False):
     """Per-slot gradients over a leading logical-slot axis, vmap-compatible.
 
     Returns exactly what ``jax.vmap(grad_fn, in_axes=(None, None, 0, 0, 0))``
@@ -193,7 +252,7 @@ def per_slot_grads(grad_fn, params, ms, x, y, keys, fused_fn=None):
     n = x.shape[0]
     if fused_fn is not None:
         return fused_fn(params, ms, x, y, keys)
-    if n > UNROLL_MAX_SLOTS:
+    if n > UNROLL_MAX_SLOTS and not force_unroll:
         return jax.vmap(grad_fn, in_axes=(None, None, 0, 0, 0))(
             params, ms, x, y, keys
         )

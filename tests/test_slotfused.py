@@ -66,6 +66,18 @@ def test_twin_matches_unroll(model, dataset, shape, rtol):
         np.testing.assert_allclose(mt, mu, rtol=1e-4, atol=1e-6)
 
 
+def test_slot_path_decision():
+    """Run-length-aware unroll/vmap choice (VERDICT r4 #8): the fused twin
+    wins when available; a reference-scale 100k-iter n=64 run takes the
+    unroll automatically; a short unknown-length large-n run keeps vmap."""
+    d = core.slot_path_decision
+    assert d(64, 100_000, True)[0] == "fused"
+    assert d(8, None, False)[0] == "unroll"           # under the cap
+    assert d(64, 100_000, False)[0] == "unroll"        # amortized
+    assert d(64, 100, False)[0] == "vmap"              # too short
+    assert d(64, None, False)[0] == "vmap"             # unknown length
+
+
 def test_unsupported_models_return_none():
     """Dropout models (convnet) keep the unroll: a twin cannot replicate
     flax's internal rng-path folding."""
