@@ -34,7 +34,8 @@ class GAR:
 
     def __init__(self, name, unchecked, check, upper_bound=None, influence=None,
                  tree_aggregate=None, gram_select=None, fold_aggregate=None,
-                 tree_aggregate_ext=None):
+                 tree_aggregate_ext=None, fold_flat_aggregate=None,
+                 stateful_center=False):
         self.name = name
         self.unchecked = unchecked
         self.check = check
@@ -67,6 +68,19 @@ class GAR:
         # kernels apply the remap in-register (ops.coordinate_median's
         # row_map/row_scale), so the poisoned stack never materializes.
         self.tree_aggregate_ext = tree_aggregate_ext
+        # Folded form for iterative row-value rules (cclip): ``
+        # fold_flat_aggregate(ext_stack, row_map, row_scale, f, **params)``
+        # receives the EXTENDED flat (rows, d) stack (raw rows + the
+        # attack's shared fake row) and the static remap/scale; the rule's
+        # per-iteration passes (radii, clipped-mean matvec) apply the remap
+        # to row-level scalars, so the poisoned stack never materializes
+        # (parallel/fold.py dispatch; returns the flat (d,) aggregate).
+        self.fold_flat_aggregate = fold_flat_aggregate
+        # True for rules that accept a ``center=`` carried across steps
+        # (cclip): topologies thread the previous aggregate through
+        # TrainState.gar_state as the next v_0 instead of paying a robust
+        # init every step (the paper's own recipe; PERF.md r5).
+        self.stateful_center = stateful_center
 
         def checked(gradients, *args, **kwargs):
             message = check(gradients, *args, **kwargs)
@@ -93,14 +107,17 @@ gars = {}
 
 def register(name, unchecked, check, upper_bound=None, influence=None,
              tree_aggregate=None, gram_select=None, fold_aggregate=None,
-             tree_aggregate_ext=None):
+             tree_aggregate_ext=None, fold_flat_aggregate=None,
+             stateful_center=False):
     """Register an aggregation rule (reference __init__.py:71-86)."""
     if name in gars:
         tools.warning(f"GAR {name!r} already registered; overwriting")
     gar = GAR(name, unchecked, check, upper_bound=upper_bound,
               influence=influence, tree_aggregate=tree_aggregate,
               gram_select=gram_select, fold_aggregate=fold_aggregate,
-              tree_aggregate_ext=tree_aggregate_ext)
+              tree_aggregate_ext=tree_aggregate_ext,
+              fold_flat_aggregate=fold_flat_aggregate,
+              stateful_center=stateful_center)
     gars[name] = gar
     return gar
 

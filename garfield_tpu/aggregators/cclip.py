@@ -15,18 +15,26 @@ enough" attack that defeats Krum AND Bulyan on the round-3 TTA grid
 standard modern baseline alongside its Krum/Median/Bulyan generation.
 
 Defaults follow the paper's practical recipe: 3 fixed-point iterations;
-``center`` starts at the coordinate-wise median (robust init — the paper
-uses the previous aggregate, which the worker-momentum trainers get
-implicitly because the momentum stack itself carries history); ``tau``
-auto-scales to the median of the current radii ||x_i - v_l|| so the rule
-is scale-free (no per-model tuning).
+``tau`` auto-scales to the median of the current radii ||x_i - v_l|| so
+the rule is scale-free (no per-model tuning). ``center``: standalone
+calls start at the coordinate-wise median (robust init); the AGGREGATHOR
+topology threads the PREVIOUS step's aggregate through
+``TrainState.gar_state`` as ``center`` — the paper's actual v_0 —
+because the per-step median init costs a full coordinate-median pass
+(~4 ms at ResNet-18 scale, the single largest piece of cclip's r4 22 ms
+step; PERF.md r5). The first step runs from v_0 = 0, whose aggregate is
+tau-bounded by construction. byzsgd/LEARN keep the per-step median init
+(their per-PS/per-node state stacks would need one carried center per
+slot; the cclip+momentum defense configs run on aggregathor/SSMW).
 
 TPU form: the whole update is elementwise + row reductions — XLA fuses
 each iteration into ~2 HBM passes over the (n, d) stack; no sort over d,
-no gather. A tree-mode twin aggregates the stacked gradient TREE without
-materializing the flat (n, d) stack (see aggregators/__init__.py on
-``tree_aggregate``): per-leaf medians + a tree-reduced squared-norm
-accumulator give the same radii.
+no gather. The tree-mode twin CONCATENATES the stacked tree once
+(axis-1) and runs the flat iterations on it — a per-leaf formulation was
+measured 7 ms/step slower (~600 small ops per aggregate; the Bulyan
+concat-first layout lesson, PERF.md r5). ``fold_flat_aggregate`` gives
+deterministic attacks a folded form (the remap applies to per-row
+scalars of the iterations; parallel/fold.py).
 """
 
 import math
@@ -79,48 +87,123 @@ def aggregate(gradients, f=0, key=None, center=None, tau=None,
 
 def tree_aggregate(stacked_tree, f=0, key=None, center=None, tau=None,
                    iters=ITERS, **kwargs):
-    """Tree-mode twin: same math, no (n, d) flat stack.
+    """Tree-mode twin: CONCAT-FIRST (the Bulyan layout lesson, PERF.md r4).
 
-    Radii need the GLOBAL row norms, which tree-reduce as the sum of
-    per-leaf squared norms; everything else is leafwise.
+    An earlier per-leaf formulation ran every iteration's subtract/normsq/
+    update across all ~62 leaves (~600 small ops per aggregate) and made
+    cclip the most expensive rule in the robustness matrix (22 ms/step vs
+    krum's 12.6, VERDICT r4 #6). One axis-1 concat turns each iteration
+    into two fused passes over a single (n, d) array — the exact flat-path
+    math, so tree == flat by construction.
     """
+    from ._common import concat_stack, unflatten_vec
+
     leaves, treedef = jax.tree.flatten(stacked_tree)
-    n = leaves[0].shape[0]
+    stack, shapes = concat_stack(leaves)
+    if center is not None:
+        center = jnp.concatenate(
+            [l.reshape(-1) for l in jax.tree.leaves(center)]
+        )
+    vec = aggregate(stack, f=f, key=key, center=center, tau=tau, iters=iters)
+    return unflatten_vec(vec, treedef, shapes)
+
+
+def fold_flat_aggregate(ext_stack, row_map, row_scale, f=0, key=None,
+                        center=None, tau=None, iters=ITERS, **kwargs):
+    """Folded-attack form: iterate on the EXTENDED raw stack (raw rows +
+    the attack's shared fake row) under the static remap/scale — the
+    poisoned (n, d) stack never materializes (parallel/fold.py).
+
+    cclip consumes rows only through per-row scalars (radii) and one
+    weighted row sum per iteration, both of which remap statically:
+
+      radius_i    = || s_i * ext[m_i] - v ||     (s, m static)
+      v          <- v * (1 - mean(c)) + (c * s / n) @ ext_rows
+
+    Radii of unit-scale rows (honest + the shared lie/empire fake) come
+    from a DIRECT fused ||row - v|| pass (no cancellation); scaled rows
+    (reverse's -factor, crash's 0) use the expansion s^2*|row|^2 -
+    2*s*<row, v> + |v|^2, clamped at 0, whose terms only add for the
+    attacks that produce them.
+
+    Non-finite guard is ROW-level here (a row with any non-finite entry
+    gets clip weight 0, i.e. votes the current center wholesale — matching
+    the where-path exactly for fully-poisoned rows like the fw=1 lie NaN
+    fake; the flat path's entry-level guard differs only for PARTIALLY
+    non-finite rows, a regime no deterministic attack produces).
+    """
+    import numpy as np
+
+    rows = ext_stack.shape[0]
+    rmap = np.asarray(row_map)
+    scales = np.asarray(row_scale, np.float32)
+    n = rmap.size
     eps = jnp.asarray(1e-12, jnp.float32)
+    finite = jnp.isfinite(ext_stack)
+    x_safe = jnp.where(finite, ext_stack, 0)
+    row_bad = jnp.any(~finite, axis=1)
+    all_unit = bool((scales == 1.0).all())  # static: lie/empire fold plans
+    sq = None
+    if not all_unit:
+        sq = jnp.sum(
+            jnp.square(x_safe.astype(jnp.float32)), axis=1
+        )  # (rows,), iteration-invariant; only scaled rows need it
+    unit = jnp.asarray(scales == 1.0)
+    s_log = jnp.asarray(scales)
     if center is None:
-        c_leaves = jax.tree.leaves(
-            tree_coordinatewise(coordinate_median, stacked_tree)
+        # Remapped-row Pallas median: the robust init sees the POISONED
+        # logical rows without them ever existing (ops row_map/row_scale).
+        from .. import ops
+
+        center = ops.coordinate_median(
+            ext_stack, row_map=rmap, row_scale=scales
         )
-    else:
-        c_leaves = jax.tree.leaves(center)
+    bad_log = row_bad[rmap] & (s_log != 0)
+    v = center
     for _ in range(iters):
-        devs = [
-            jnp.nan_to_num(
-                l - c[None], nan=0.0, posinf=0.0, neginf=0.0
+        vf = v.astype(jnp.float32)
+        # ONE fused read of the stack: ||row - v||^2 (and <row, v> only
+        # when some scale != 1 — lie/empire plans are all-unit, statically).
+        dev = x_safe.astype(jnp.float32) - vf[None, :]
+        nsq_direct = jnp.sum(dev * dev, axis=1)
+        if all_unit:
+            nsq_log = nsq_direct[rmap]
+        else:
+            vsq = jnp.sum(vf * vf)
+            dot = jnp.sum(x_safe.astype(jnp.float32) * vf[None, :], axis=1)
+            nsq_log = jnp.where(
+                unit,
+                nsq_direct[rmap],
+                jnp.maximum(
+                    s_log * s_log * sq[rmap] - 2.0 * s_log * dot[rmap]
+                    + vsq,
+                    0.0,
+                ),
             )
-            for l, c in zip(leaves, c_leaves)
-        ]
-        sq = sum(
-            jnp.sum(
-                jnp.square(d.astype(jnp.float32)).reshape(n, -1), axis=1
-            )
-            for d in devs
-        )
-        norms = jnp.sqrt(sq)
+        # Non-finite LOGICAL rows (a zero-scaled crash row is exactly the
+        # zero vector — finite — whatever the raw row holds): the
+        # where-path's nan_to_num gives them dev = 0, i.e. RADIUS 0 — the
+        # zero must enter the tau median too, not ||v|| from the sanitized
+        # buffer (ADVICE-of-record: confirmed tau shift otherwise).
+        nsq_log = jnp.where(bad_log, 0.0, nsq_log)
+        norms = jnp.sqrt(nsq_log)
         tau_l = jnp.median(norms) if tau is None else jnp.asarray(
             tau, jnp.float32
         )
-        scale = jnp.minimum(1.0, tau_l / jnp.maximum(norms, eps))
-        c_leaves = [
-            c + jnp.mean(
-                d * scale.reshape((n,) + (1,) * (d.ndim - 1)).astype(
-                    d.dtype
-                ),
-                axis=0,
+        clip = jnp.minimum(1.0, tau_l / jnp.maximum(norms, eps))
+        # clip = 0 for bad rows reproduces the where-path contribution
+        # exactly: its clip * dev term is 0 either way.
+        clip = jnp.where(bad_log, 0.0, clip)
+        w_log = clip * s_log / n                     # logical row weights
+        w_phys = jnp.zeros((rows,), jnp.float32).at[rmap].add(w_log)
+        v = (
+            v.astype(jnp.float32) * (1.0 - jnp.sum(clip) / n)
+            + jnp.matmul(
+                w_phys.astype(ext_stack.dtype), x_safe,
+                preferred_element_type=jnp.float32,
             )
-            for c, d in zip(c_leaves, devs)
-        ]
-    return jax.tree.unflatten(treedef, c_leaves)
+        ).astype(v.dtype)
+    return v
 
 
 def check(gradients, f=0, **kwargs):
@@ -142,4 +225,6 @@ def upper_bound(n, f, d):
 
 
 register("cclip", aggregate, check, upper_bound=upper_bound,
-         tree_aggregate=tree_aggregate)
+         tree_aggregate=tree_aggregate,
+         fold_flat_aggregate=fold_flat_aggregate,
+         stateful_center=True)

@@ -44,7 +44,12 @@ from jax.experimental import pallas as pl
 MAX_SORT_N = 32
 
 _LANES = 128
-_TILE = 1024  # lanes per program: 32 rows x 1024 x 4 B = 128 KiB of VMEM
+# Lanes per program. Swept on the v5e chip (r5, n=8 d=11.2M f32): 1024 ->
+# 5.8 ms, 4096 -> 4.4, 8192 -> 3.8 (best), 16384+ regress — the old 1024
+# default optimized for a 128 KiB VMEM budget that is ~100x below the
+# ~16 MB/core reality, and its 10.9k-program grid paid per-program
+# overhead. Worst case (n = MAX_SORT_N + out + padding) stays under 2 MB.
+_TILE = 8192
 
 _warned_large_n = set()
 
@@ -152,16 +157,18 @@ def _tmean_kernel(n, f, sel, x_ref, o_ref):
     o_ref[0, :] = (acc / (n - 2 * f)).astype(o_ref.dtype)
 
 
-def _avgmed_kernel(s, beta, x_ref, o_ref):
+def _avgmed_kernel(s, beta, quant_dtype, x_ref, o_ref):
     vals = _load_rows(x_ref, s)
     med = _oddeven_exchange(list(vals))[(s - 1) // 2]
-    # Deviations are the SORT KEYS and must carry the input dtype's
-    # rounding: the spec computes |g - med| in the input dtype, where bf16
-    # rounding creates ties (broken stably by row index) that exact f32
-    # deviations would order differently. Quantize, then upcast for the
-    # comparisons Mosaic supports.
+    # Deviations are the SORT KEYS and must carry the LOGICAL input
+    # dtype's rounding: the spec computes |g - med| in the caller's dtype,
+    # where bf16 rounding creates ties (broken stably by row index) that
+    # exact f32 deviations would order differently. ``quant_dtype`` is the
+    # caller's dtype — the kernel itself now always runs on f32 blocks
+    # (_dispatch upcasts half inputs), so x_ref.dtype no longer carries
+    # it. Quantize, then upcast for the comparisons Mosaic supports.
     devs = [
-        jnp.abs(v - med).astype(x_ref.dtype).astype(jnp.float32)
+        jnp.abs(v - med).astype(quant_dtype).astype(jnp.float32)
         for v in vals
     ]
     _, picked = _oddeven_exchange(devs, vals)
@@ -249,13 +256,29 @@ def _dispatch(g, kernel, fallback_fn, tile, interpret, n, op):
     ``interpret=True`` — an interpret-mode call runs the kernel and must
     not warn or consume the once-per-op warning budget.
     """
+    # Half-precision inputs run the KERNEL in f32: Mosaic's packed (2, 1)
+    # sublane loads + per-row converts made the bf16 kernel SLOWER than
+    # the f32 one despite half the HBM traffic (measured r5: 7.8 vs
+    # 3.8 ms at n=8 d=11.2M), so one XLA convert outside the kernel wins
+    # ~2x. bf16 -> f32 is exact, selection ops (median) round-trip
+    # losslessly, and the mean-producing kernels (tmean/avgmed) gain f32
+    # accumulation accuracy before the single round back.
+    orig = g.dtype
+    half = orig in (jnp.bfloat16, jnp.float16)
+
+    def run_kernel(a, interp):
+        out = _column_call(
+            kernel, a.astype(jnp.float32) if half else a, tile, interp
+        )
+        return out.astype(orig) if half else out
+
     if interpret:
-        return _column_call(kernel, g, tile, True)
+        return run_kernel(g, True)
     if not use_pallas(n, op=op):
         return fallback_fn(g)
     return jax.lax.platform_dependent(
         g,
-        tpu=lambda a: _column_call(kernel, a, tile, False),
+        tpu=lambda a: run_kernel(a, False),
         default=fallback_fn,
     )
 
@@ -365,7 +388,7 @@ def averaged_median_mean(g, beta, *, interpret=False, tile=_TILE):
     if not (1 <= beta <= s):
         raise ValueError(f"beta must be in [1, {s}], got {beta}")
     return _dispatch(
-        g, functools.partial(_avgmed_kernel, s, beta),
+        g, functools.partial(_avgmed_kernel, s, beta, g.dtype),
         lambda a: averaged_median_mean_xla(a, beta), tile, interpret,
         s, "averaged_median_mean",
     )

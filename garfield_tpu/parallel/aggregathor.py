@@ -82,10 +82,11 @@ def _tree_path_ok(tree_path, subset, num_slots, granularity, gar):
 
 def _attack_then_aggregate(
     flat_stack, byz_mask, atk_key, sub_key, gar_key, *, attack,
-    attack_params, gar, f, subset, gar_params,
+    attack_params, gar, f, subset, gar_params, center=None,
 ):
     """Poison rows, optionally subsample (wait n-f), aggregate. Pure.
-    ``gar_key`` seeds randomized rules (condense's Bernoulli mask)."""
+    ``gar_key`` seeds randomized rules (condense's Bernoulli mask);
+    ``center`` threads a stateful rule's carried v_0 (cclip)."""
     n = flat_stack.shape[0]
     stack = apply_gradient_attack(
         attack, flat_stack, byz_mask, key=atk_key, **attack_params
@@ -93,7 +94,8 @@ def _attack_then_aggregate(
     if subset is not None and subset < n:
         sel = core.subset_indices(sub_key, n, subset)
         stack = stack[sel]
-    return gar.unchecked(stack, f=f, key=gar_key, **gar_params)
+    extra = {} if center is None else {"center": center}
+    return gar.unchecked(stack, f=f, key=gar_key, **gar_params, **extra)
 
 
 def make_trainer(
@@ -168,6 +170,13 @@ def make_trainer(
     gar = _resolve_gar(gar)
     attack_params = dict(attack_params or {})
     gar_params = dict(gar_params or {})
+    if gar.stateful_center and "center" in gar_params:
+        raise ValueError(
+            f"{gar.name!r} carries its center across steps "
+            "(TrainState.gar_state); a fixed gar_params 'center' would "
+            "silently fight the carried state — remove it (standalone "
+            "gars[...](stack, center=...) calls still accept one)"
+        )
     if mesh is None:
         mesh = mesh_lib.make_mesh({axis: -1})
     if subset is not None and not (1 <= subset <= num_workers):
@@ -208,6 +217,14 @@ def make_trainer(
         worker_mom = None
         if worker_momentum is not None:
             worker_mom = core.worker_mom_init(params, num_workers, gar_dtype)
+        gar_state = None
+        if gar.stateful_center:
+            # cclip's carried center (v_0 = previous aggregate, the
+            # paper's recipe); zeros at step 0 — that first aggregate is
+            # tau-bounded from the origin (cclip.py docstring).
+            gar_state = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
         state = core.TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -215,6 +232,7 @@ def make_trainer(
             opt_state=opt_state,
             rng=key if seed_rng is None else seed_rng,
             worker_mom=worker_mom,
+            gar_state=gar_state,
         )
         return jax.device_put(state, repl)
 
@@ -267,6 +285,9 @@ def make_trainer(
             attack=attack, attack_params=attack_params, gar=gar, f=f,
             subset=subset, gar_params=gar_params,
         )
+        center_kw = (
+            {"center": state.gar_state} if gar.stateful_center else {}
+        )
         if _tree_path_ok(tree_path, subset, num_workers, granularity, gar):
             # Tree-mode fast path: no (n, d) flat stack (PERF.md: the
             # flatten + unflatten round trip costs ~5 ms/step at ResNet-18
@@ -278,39 +299,59 @@ def make_trainer(
                 # krum+lie north-star).
                 aggr_tree = fold.folded_tree_aggregate(
                     gar, fold_plan, grads, f=f, key=gar_key,
-                    gar_params=gar_params,
+                    gar_params={**gar_params, **center_kw},
                 )
             else:
                 poisoned = apply_gradient_attack_tree(
                     attack, grads, byz_mask, key=atk_key, **attack_params
                 )
                 aggr_tree = gar.tree_aggregate(
-                    poisoned, f=f, key=gar_key, **gar_params
+                    poisoned, f=f, key=gar_key, **gar_params, **center_kw
                 )
         elif granularity == "layer":
             # Garfield_CC per-parameter aggregation: independent GAR (and
             # attack statistics) per tensor, like the reference's per-layer
             # gather->GAR loop (Garfield_CC/trainer.py:91-127). Each leaf is
-            # reshaped in place (free) — no flat stack is built.
+            # reshaped in place (free) — no flat stack is built. Stateful
+            # rules (cclip) get their carried center per leaf, so layer
+            # aggregation keeps the same v_0 semantics as whole-model.
             leaves, treedef = jax.tree.flatten(grads)
+            c_leaves = (
+                jax.tree.leaves(state.gar_state) if gar.stateful_center
+                else [None] * len(leaves)
+            )
             out_leaves = []
-            for i, leaf in enumerate(leaves):
+            for i, (leaf, c) in enumerate(zip(leaves, c_leaves)):
                 n = leaf.shape[0]
                 flat = leaf.reshape(n, -1)
                 akey = jax.random.fold_in(atk_key, i)
                 gkey = jax.random.fold_in(gar_key, i)
                 aggr = _attack_then_aggregate(
-                    flat, byz_mask, akey, sub_key, gkey, **agg_kwargs
+                    flat, byz_mask, akey, sub_key, gkey,
+                    **agg_kwargs,
+                    **({"center": c.reshape(-1)} if c is not None else {}),
                 )
                 out_leaves.append(aggr.reshape(leaf.shape[1:]))
             aggr_tree = jax.tree.unflatten(treedef, out_leaves)
         else:
             flat_stack = core.flatten_rows(grads)  # (n_w, d)
+            flat_center = (
+                {"center": ravel_pytree(state.gar_state)[0]}
+                if gar.stateful_center else {}
+            )
             aggr = _attack_then_aggregate(
-                flat_stack, byz_mask, atk_key, sub_key, gar_key, **agg_kwargs
+                flat_stack, byz_mask, atk_key, sub_key, gar_key,
+                **agg_kwargs, **flat_center,
             )
             aggr_tree = core.unflatten_like(params, aggr)
 
+        new_gar_state = state.gar_state
+        if gar.stateful_center:
+            # Next step's v_0 = this step's aggregate (f32 — the carried
+            # center should not round through the bf16 pipeline).
+            new_gar_state = jax.tree.map(
+                lambda l: l.astype(jnp.float32), aggr_tree
+            )
         aggr_tree = core.cast_like(aggr_tree, params)  # no-op at f32
         updates, new_opt = optimizer.update(aggr_tree, state.opt_state, params)
         new_params = optax.apply_updates(params, updates)
@@ -320,6 +361,7 @@ def make_trainer(
             model_state=new_ms,
             opt_state=new_opt,
             worker_mom=new_mom,
+            gar_state=new_gar_state,
         )
         return new_state, {"loss": mean_loss}
 

@@ -60,6 +60,13 @@ class TrainState:
     # accordingly on large models), LEARN shards the leading axis at
     # P(axis) with params/opt_state.
     worker_mom: object = None
+    # Carried aggregation state for stateful-center rules (cclip): the
+    # previous step's aggregate tree, used as the next step's center v_0 —
+    # the paper's actual recipe (Karimireddy et al. 2021 set v_0 to the
+    # previous aggregate; a per-step robust median init costs a full
+    # coordinate-median pass, ~4 ms at ResNet-18 scale, PERF.md r5).
+    # None for stateless rules.
+    gar_state: object = None
 
 
 def make_worker_fns(module, loss_fn):

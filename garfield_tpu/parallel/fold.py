@@ -32,10 +32,11 @@ each other (PERF.md).
 Applies when the topology's tree path is eligible, the attack is
 deterministic (lie/empire/reverse/crash), and the rule exposes a
 fold-capable interface: ``gram_select`` (krum, average),
-``fold_aggregate`` (Bulyan), or ``tree_aggregate_ext`` (the
-coordinate-wise median/tmean — their Pallas kernels apply the row
-remap/scale in-register, ops/coordinate.py). Randomized attacks
-(random/drop) and cclip keep the ``where`` tree path. Zero-scale rows
+``fold_aggregate`` (Bulyan), ``tree_aggregate_ext`` (the coordinate-wise
+median/tmean — their Pallas kernels apply the row remap/scale
+in-register, ops/coordinate.py), or ``fold_flat_aggregate`` (cclip —
+the remap applies to per-row scalars of its iterations, r5). Randomized
+attacks (random/drop) keep the ``where`` tree path. Zero-scale rows
 (the crash attack) are sanitized everywhere a 0*inf could otherwise
 produce NaN: the remapped Gram's zero-scale rows/cols are forced to
 exact zeros (matching the where-path's literal zero row, whose inner
@@ -65,7 +66,8 @@ def plan_for(gar, attack, byz_mask, attack_params):
     actual Byzantine slots, and GARFIELD_NO_FOLD unset). ``byz_mask`` may
     be any array-like; it must be concrete (the plan is static)."""
     if (gar.gram_select is None and gar.fold_aggregate is None
-            and gar.tree_aggregate_ext is None):
+            and gar.tree_aggregate_ext is None
+            and gar.fold_flat_aggregate is None):
         return None
     return plan_gradient_attack_fold(
         attack, np.asarray(byz_mask, dtype=bool), **attack_params
@@ -103,7 +105,11 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
     """
     leaves, treedef = jax.tree.flatten(stacked_tree)
     n = leaves[0].shape[0]
-    params = gar_params or {}
+    params = dict(gar_params or {})
+    # Carried center (stateful rules, cclip): arrives as a params-shaped
+    # TREE from TrainState.gar_state; only the flat-iteration branch
+    # consumes it (as the concatenated vector).
+    center_tree = params.pop("center", None)
 
     def sanitize_gram(gram_p):
         """Force zero-scale (crash) rows/cols of the remapped Gram to exact
@@ -142,6 +148,34 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
         w = w.astype(jnp.float32) * scale
         w_ext = jnp.zeros((n + plan.num_extra,), jnp.float32).at[rmap].add(w)
         return tree_weighted_sum(ext, w_ext)
+
+    if gar.fold_flat_aggregate is not None:
+        # Iterative row-value rules (cclip): the rule needs actual row
+        # values every iteration, so the EXTENDED stack is materialized
+        # once (concat-first, like Bulyan's layout) and the remap/scale is
+        # applied to row-level scalars inside the rule — still no poisoned
+        # stack, no per-iteration attack passes.
+        from ..aggregators._common import concat_stack, unflatten_vec
+
+        stack, shapes = concat_stack(leaves)
+        if plan.build_extra is not None:
+            extra = plan.build_extra(stacked_tree)
+            a_flat = jnp.concatenate(
+                [l.reshape(-1) for l in jax.tree.leaves(extra)]
+            )
+            stack = jnp.concatenate(
+                [stack, a_flat[None].astype(stack.dtype)], axis=0
+            )
+        center = None
+        if center_tree is not None:
+            center = jnp.concatenate(
+                [l.reshape(-1) for l in jax.tree.leaves(center_tree)]
+            )
+        vec = gar.fold_flat_aggregate(
+            stack, plan.row_map, plan.row_scale, f=f, key=key,
+            center=center, **params,
+        )
+        return unflatten_vec(vec, treedef, shapes)
 
     # fold_aggregate rules: flat-block layout.
     from ..aggregators._common import concat_stack, unflatten_vec

@@ -60,10 +60,11 @@ class TestFoldedAggregate:
     # bulyan (n >= 4f+3) runs at f=1 and exercises the fold_aggregate
     # branch (weight-MATRIX apply_rows); krum/average the gram_select
     # branch; median/tmean the coordinate-wise tree_aggregate_ext branch
-    # (remapped-row kernels).
+    # (remapped-row kernels); cclip the fold_flat_aggregate branch
+    # (extended-stack iterations, r5).
     @pytest.mark.parametrize("gar_name,f", [
         ("krum", F), ("average", F), ("bulyan", 1),
-        ("median", F), ("tmean", F),
+        ("median", F), ("tmean", F), ("cclip", F),
     ])
     @pytest.mark.parametrize("attack", ["lie", "empire", "reverse", "crash"])
     def test_matches_where_path(self, gar_name, f, attack):
@@ -132,6 +133,59 @@ class TestFoldedAggregate:
         for leaf in jax.tree.leaves(got):
             assert np.isfinite(np.asarray(leaf)).all()
 
+    @pytest.mark.parametrize("carried_center", [False, True])
+    def test_cclip_lie_single_byzantine_nan_cohort(self, carried_center):
+        """fw=1 lie: the fake row is all-NaN (Bessel std of one sample).
+        cclip's fold guards at ROW level (weight 0 == vote the current
+        center), which coincides with the where-path's entry-level guard
+        exactly when the whole row is non-finite — this case. The carried
+        (nonzero) center variant covers the PRODUCTION configuration (v_0
+        = previous aggregate): the NaN row's radius must enter the tau
+        median as the where-path's 0, not ||v|| (review-caught tau shift,
+        r5)."""
+        mask = core.default_byz_mask(N, 1)
+        tree = _stacked_tree(jax.random.PRNGKey(11))
+        center = (
+            jax.tree.map(
+                lambda l: 3.0 + jnp.mean(l, axis=0), tree
+            ) if carried_center else None
+        )
+        plan = plan_gradient_attack_fold("lie", mask)
+        got = folded_tree_aggregate(
+            gars["cclip"], plan, tree, f=1,
+            gar_params={"center": center} if center is not None else None,
+        )
+        poisoned = apply_gradient_attack_tree("lie", tree, jnp.asarray(mask))
+        want = gars["cclip"].tree_aggregate(poisoned, f=1, center=center)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            got, want,
+        )
+        for leaf in jax.tree.leaves(got):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    @pytest.mark.parametrize("attack", ["lie", "empire", "reverse", "crash"])
+    def test_cclip_fold_with_carried_center_matches_where_path(self, attack):
+        """Every deterministic attack folds identically under a carried
+        nonzero center (the aggregathor stateful-center configuration)."""
+        mask = core.default_byz_mask(N, F)
+        tree = _stacked_tree(jax.random.PRNGKey(17))
+        center = jax.tree.map(lambda l: 1.5 * jnp.mean(l, axis=0), tree)
+        plan = plan_gradient_attack_fold(attack, mask)
+        got = folded_tree_aggregate(
+            gars["cclip"], plan, tree, f=F, gar_params={"center": center}
+        )
+        poisoned = apply_gradient_attack_tree(attack, tree, jnp.asarray(mask))
+        want = gars["cclip"].tree_aggregate(poisoned, f=F, center=center)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            got, want,
+        )
+
     def test_gram_select_consistency(self):
         """gram_select(stack @ stack.T) @ stack == aggregate(stack)."""
         g = jax.random.normal(jax.random.PRNGKey(2), (N, 33))
@@ -147,6 +201,7 @@ class TestFoldedAggregate:
     ("median", 1), ("tmean", 1),      # coordinate-wise kernels
     ("krum", 1), ("average", 1),      # gram_select (sanitized Gram)
     ("bulyan", 1),                    # fold_aggregate (sanitized Gram)
+    ("cclip", 1),                     # fold_flat (row-level guard)
 ])
 def test_crash_fold_nonfinite_row_stays_zero(gar_name, f):
     """A crashed slot whose raw gradient overflowed (inf) must behave as
