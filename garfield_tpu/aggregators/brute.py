@@ -35,23 +35,71 @@ def _combination_table(n, f):
     return combos  # (C, n-f)
 
 
-def selection_indices(gradients, f):
-    """Index set (n-f,) of the minimum-diameter subset."""
-    g = as_stack(gradients)
-    n = g.shape[0]
+def _min_diameter_subset(dist, n, f):
+    """(n-f,) indices of the minimum-diameter subset — the single source
+    of the selection math (flat, tree, Gram-form, and influence paths all
+    route here, so their trajectory equality cannot silently drift)."""
     combos = _combination_table(n, f)
-    dist = pairwise_distances(g, exclude_self=False)  # diag 0, non-finite inf
     # (C, k, k) pairwise distances inside each candidate subset.
     sub = dist[combos[:, :, None], combos[:, None, :]]
     diam = jnp.max(sub, axis=(1, 2))  # inf iff subset holds a non-finite pair
     return jnp.asarray(combos)[jnp.argmin(diam)]
 
 
-def aggregate(gradients, f, **kwargs):
-    """Average of the minimum-diameter subset of size n-f."""
+def _selection_weights_from_dist(dist, n, f):
+    """1/(n-f) one-hot weights over the minimum-diameter subset."""
+    sel = _min_diameter_subset(dist, n, f)
+    return jnp.zeros((n,), jnp.float32).at[sel].set(1.0 / (n - f))
+
+
+def selection_indices(gradients, f):
+    """Index set (n-f,) of the minimum-diameter subset."""
     g = as_stack(gradients)
-    sel = selection_indices(g, f)
-    return jnp.mean(g[sel], axis=0)
+    return _min_diameter_subset(
+        pairwise_distances(g, exclude_self=False), g.shape[0], f
+    )
+
+
+def aggregate(gradients, f, **kwargs):
+    """Average of the minimum-diameter subset of size n-f.
+
+    Masked matvec instead of ``mean(g[sel])`` — the same zero-guarded
+    one-hot form as krum's (PERF.md: fuses, and 0 * inf stays 0)."""
+    g = as_stack(gradients)
+    n = g.shape[0]
+    w = _selection_weights_from_dist(
+        pairwise_distances(g, exclude_self=False), n, f
+    ).astype(g.dtype)
+    gz = jnp.where((w != 0)[:, None], g, 0)
+    return w @ gz
+
+
+def tree_aggregate(grads_tree, f, **kwargs):
+    """Tree-mode brute: the min-diameter selection needs only pairwise
+    distances, i.e. the summed per-leaf Gram (krum's trick — the
+    reference's own selection is pure pairwise-distance, brute.py:32-68);
+    the average is one per-leaf weighted row sum."""
+    import jax
+
+    from ._common import distances_from_gram, tree_gram, tree_weighted_sum
+
+    leaves = jax.tree.leaves(grads_tree)
+    n = leaves[0].shape[0]
+    dist = distances_from_gram(tree_gram(grads_tree), exclude_self=False)
+    return tree_weighted_sum(
+        grads_tree, _selection_weights_from_dist(dist, n, f)
+    )
+
+
+def gram_select(gram, f, **kwargs):
+    """Gram-form selection weights (parallel.fold): the folded-attack path
+    remaps THIS matrix instead of writing poisoned rows."""
+    from ._common import distances_from_gram
+
+    n = gram.shape[0]
+    return _selection_weights_from_dist(
+        distances_from_gram(gram, exclude_self=False), n, f
+    )
 
 
 def check(gradients, f, **kwargs):
@@ -85,4 +133,6 @@ def influence(honests, attacks, f, **kwargs):
     return float(np.sum(sel >= len(honests))) / (stack.shape[0] - f)
 
 
-register("brute", aggregate, check, upper_bound=upper_bound, influence=influence)
+register("brute", aggregate, check, upper_bound=upper_bound,
+         influence=influence, tree_aggregate=tree_aggregate,
+         gram_select=gram_select)

@@ -142,13 +142,18 @@ def fold_flat_aggregate(ext_stack, row_map, row_scale, f=0, key=None,
     finite = jnp.isfinite(ext_stack)
     x_safe = jnp.where(finite, ext_stack, 0)
     row_bad = jnp.any(~finite, axis=1)
-    all_unit = bool((scales == 1.0).all())  # static: lie/empire fold plans
+    unit_np = scales == 1.0
+    all_unit = bool(unit_np.all())  # static: lie/empire fold plans
+    # Crash's zero scales degenerate the expansion to ||v||^2 — no stack
+    # passes needed for them either (the general sq/dot algebra is only
+    # for exotic scale values like reverse's -factor).
+    zero_or_unit = bool((scales[~unit_np] == 0.0).all())
     sq = None
-    if not all_unit:
+    if not (all_unit or zero_or_unit):
         sq = jnp.sum(
             jnp.square(x_safe.astype(jnp.float32)), axis=1
         )  # (rows,), iteration-invariant; only scaled rows need it
-    unit = jnp.asarray(scales == 1.0)
+    unit = jnp.asarray(unit_np)
     s_log = jnp.asarray(scales)
     if center is None:
         # Remapped-row Pallas median: the robust init sees the POISONED
@@ -168,6 +173,9 @@ def fold_flat_aggregate(ext_stack, row_map, row_scale, f=0, key=None,
         nsq_direct = jnp.sum(dev * dev, axis=1)
         if all_unit:
             nsq_log = nsq_direct[rmap]
+        elif zero_or_unit:
+            vsq = jnp.sum(vf * vf)
+            nsq_log = jnp.where(unit, nsq_direct[rmap], vsq)
         else:
             vsq = jnp.sum(vf * vf)
             dot = jnp.sum(x_safe.astype(jnp.float32) * vf[None, :], axis=1)

@@ -31,6 +31,73 @@ def aggregate(gradients, f, p=0.9, key=None, **kwargs):
     return coordinate_median(g) * mask + g[0] * (1.0 - mask)
 
 
+def _leaf_spans(leaves):
+    spans, off = [], 0
+    for l in leaves:
+        size = 1
+        for s in l.shape[1:]:
+            size *= s
+        spans.append((off, off + size))
+        off += size
+    return spans, off
+
+
+def tree_aggregate(stacked_tree, f, p=0.9, key=None, **kwargs):
+    """Tree-mode condense, EXACTLY equal to the flat path: the Bernoulli
+    mask is drawn once over the full flat dimension (the same (d,) draw
+    the flat path makes) and SLICED per leaf in ravel order, so the same
+    key gives the same trajectory on either path; the median runs per leaf
+    (Pallas kernels on TPU)."""
+    from ._common import tree_coordinatewise
+
+    leaves, treedef = jax.tree.flatten(stacked_tree)
+    spans, d = _leaf_spans(leaves)
+    if key is None:
+        key = jax.random.key(0)
+    mask = jax.random.bernoulli(key, p, shape=(d,))
+    med = jax.tree.leaves(
+        tree_coordinatewise(coordinate_median, stacked_tree)
+    )
+    out = []
+    for l, m, (a, b) in zip(leaves, med, spans):
+        mk = mask[a:b].reshape(l.shape[1:]).astype(l.dtype)
+        out.append(m * mk + l[0] * (1.0 - mk))
+    return jax.tree.unflatten(treedef, out)
+
+
+def tree_aggregate_ext(ext_tree, row_map, row_scale, f=0, key=None, p=0.9,
+                       **kwargs):
+    """Folded-attack twin (parallel/fold.py): per-leaf REMAPPED medians
+    (the Pallas kernels apply row_map/row_scale in-register) and the
+    poisoned row 0 reconstructed from the remap — one static row index and
+    scale — so the poisoned stack never materializes."""
+    import numpy as np
+
+    from .. import ops
+
+    rmap = np.asarray(row_map)
+    scales = np.asarray(row_scale, np.float32)
+    leaves, treedef = jax.tree.flatten(ext_tree)
+    spans, d = _leaf_spans(leaves)
+    if key is None:
+        key = jax.random.key(0)
+    mask = jax.random.bernoulli(key, p, shape=(d,))
+    i0, s0 = int(rmap[0]), float(scales[0])
+    out = []
+    for l, (a, b) in zip(leaves, spans):
+        n = l.shape[0]
+        med = ops.coordinate_median(
+            l.reshape(n, -1), row_map=rmap, row_scale=scales
+        ).reshape(l.shape[1:])
+        if s0 == 0.0:
+            row0 = jnp.zeros_like(l[i0])  # crash: exact zeros, not 0*inf
+        else:
+            row0 = l[i0] if s0 == 1.0 else l[i0] * s0
+        mk = mask[a:b].reshape(l.shape[1:]).astype(l.dtype)
+        out.append(med.astype(l.dtype) * mk + row0 * (1.0 - mk))
+    return jax.tree.unflatten(treedef, out)
+
+
 def check(gradients, f, p=0.9, key=None, **kwargs):
     n = num_gradients(gradients)
     if n < 1:
@@ -50,4 +117,6 @@ def upper_bound(n, f, d):
     return 1 / math.sqrt(n - f)
 
 
-register("condense", aggregate, check, upper_bound=upper_bound)
+register("condense", aggregate, check, upper_bound=upper_bound,
+         tree_aggregate=tree_aggregate,
+         tree_aggregate_ext=tree_aggregate_ext)

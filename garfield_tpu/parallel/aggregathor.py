@@ -63,18 +63,28 @@ def _check_gar(gar, n_effective, f, d=2):
         )
 
 
-def _tree_path_ok(tree_path, subset, num_slots, granularity, gar):
+def _tree_path_ok(tree_path, subset, num_slots, granularity, gar,
+                  subset_gram_ok=False):
     """Shared tree-fast-path eligibility gate (aggregathor AND byzsgd).
 
-    A true wait-n-f subset forces the flat path: row selection on a TREE is
-    one dynamic gather per leaf (62 x per-PS at ResNet-18 scale), measured
-    3.5x slower than the flat path's single (n, d) gather (PERF.md).
-    subset >= num_slots never selects rows, so it stays tree-eligible.
-    Layer granularity and rules without tree aggregation use the flat path.
+    A true wait-n-f subset forces the flat path for most rules: row
+    selection on a TREE is one dynamic gather per leaf (62 x per-PS at
+    ResNet-18 scale), measured 3.5x slower than the flat path's single
+    (n, d) gather (PERF.md). EXCEPT Gram-form rules when the caller
+    implements the sub-Gram composition (``subset_gram_ok`` —
+    aggregathor): their selection needs only the (q, q) gather of the
+    tiny Gram plus a weight scatter, so the async emulation keeps the
+    tree/fold fast path (VERDICT r4 #5). subset >= num_slots never
+    selects rows, so it stays tree-eligible everywhere. Layer granularity
+    and rules without tree aggregation use the flat path.
     """
+    subset_ok = (
+        subset is None or subset >= num_slots
+        or (subset_gram_ok and gar.gram_select is not None)
+    )
     return (
         tree_path
-        and (subset is None or subset >= num_slots)
+        and subset_ok
         and granularity != "layer"
         and gar.tree_aggregate is not None
     )
@@ -288,10 +298,19 @@ def make_trainer(
         center_kw = (
             {"center": state.gar_state} if gar.stateful_center else {}
         )
-        if _tree_path_ok(tree_path, subset, num_workers, granularity, gar):
+        if _tree_path_ok(tree_path, subset, num_workers, granularity, gar,
+                         subset_gram_ok=True):
             # Tree-mode fast path: no (n, d) flat stack (PERF.md: the
             # flatten + unflatten round trip costs ~5 ms/step at ResNet-18
-            # scale on one chip). True subsets go flat — see _tree_path_ok.
+            # scale on one chip). True subsets stay here for Gram-form
+            # rules (sub-Gram composition); others go flat —
+            # see _tree_path_ok.
+            sel = None
+            if subset is not None and subset < num_workers:
+                # SAME key derivation as the flat path's
+                # _attack_then_aggregate, so tree and flat trajectories
+                # sample identical wait-n-f subsets.
+                sel = core.subset_indices(sub_key, num_workers, subset)
             if fold_plan is not None:
                 # Folded attack: poison the Gram, never the rows — the raw
                 # per-leaf Grams keep fusing into the backward epilogue
@@ -300,14 +319,33 @@ def make_trainer(
                 aggr_tree = fold.folded_tree_aggregate(
                     gar, fold_plan, grads, f=f, key=gar_key,
                     gar_params={**gar_params, **center_kw},
+                    subset_sel=sel,
                 )
             else:
                 poisoned = apply_gradient_attack_tree(
                     attack, grads, byz_mask, key=atk_key, **attack_params
                 )
-                aggr_tree = gar.tree_aggregate(
-                    poisoned, f=f, key=gar_key, **gar_params, **center_kw
-                )
+                if sel is not None:
+                    # Wait-n-f on the Gram: select on the (q, q) sub-Gram,
+                    # scatter the weights back — per-leaf row gathers never
+                    # happen (the 3.5x regression _tree_path_ok documents).
+                    from ..aggregators._common import (
+                        tree_gram, tree_weighted_sum,
+                    )
+
+                    gram = tree_gram(poisoned)
+                    w_sub = gar.gram_select(
+                        gram[sel][:, sel], f=f, key=gar_key, **gar_params
+                    )
+                    w = jnp.zeros(
+                        (num_workers,), jnp.float32
+                    ).at[sel].set(w_sub)
+                    aggr_tree = tree_weighted_sum(poisoned, w)
+                else:
+                    aggr_tree = gar.tree_aggregate(
+                        poisoned, f=f, key=gar_key, **gar_params,
+                        **center_kw
+                    )
         elif granularity == "layer":
             # Garfield_CC per-parameter aggregation: independent GAR (and
             # attack statistics) per tensor, like the reference's per-layer

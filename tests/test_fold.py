@@ -65,6 +65,9 @@ class TestFoldedAggregate:
     @pytest.mark.parametrize("gar_name,f", [
         ("krum", F), ("average", F), ("bulyan", 1),
         ("median", F), ("tmean", F), ("cclip", F),
+        # r5 completions: brute (gram_select), aksel (fold_flat),
+        # condense (remapped-row kernels + reconstructed row 0).
+        ("brute", F), ("aksel", F), ("condense", F),
     ])
     @pytest.mark.parametrize("attack", ["lie", "empire", "reverse", "crash"])
     def test_matches_where_path(self, gar_name, f, attack):
@@ -72,15 +75,50 @@ class TestFoldedAggregate:
         mask = core.default_byz_mask(N, f)
         tree = _stacked_tree(jax.random.PRNGKey(3))
         plan = plan_gradient_attack_fold(attack, mask)
-        got = folded_tree_aggregate(gar, plan, tree, f=f)
+        key = jax.random.PRNGKey(7)  # condense's mask; inert elsewhere
+        got = folded_tree_aggregate(gar, plan, tree, f=f, key=key)
         poisoned = apply_gradient_attack_tree(attack, tree, jnp.asarray(mask))
-        want = gar.tree_aggregate(poisoned, f=f)
+        want = gar.tree_aggregate(poisoned, f=f, key=key)
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
             ),
             got, want,
         )
+
+    @pytest.mark.parametrize("gar_name", ["krum", "average", "brute"])
+    @pytest.mark.parametrize("attack", ["lie", "reverse"])
+    def test_subset_composes_with_fold(self, gar_name, attack):
+        """Wait-n-f subsets compose with the fold for Gram-form rules: the
+        sub-Gram selection must equal poisoning + row subset + rule."""
+        gar = gars[gar_name]
+        mask = core.default_byz_mask(N, F)
+        tree = _stacked_tree(jax.random.PRNGKey(19))
+        q = N - 1
+        sel = core.subset_indices(jax.random.PRNGKey(23), N, q)
+        plan = plan_gradient_attack_fold(attack, mask)
+        got = folded_tree_aggregate(
+            gar, plan, tree, f=F, subset_sel=sel
+        )
+        poisoned = apply_gradient_attack_tree(attack, tree, jnp.asarray(mask))
+        sub = jax.tree.map(lambda l: l[sel], poisoned)
+        want = gar.tree_aggregate(sub, f=F)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            got, want,
+        )
+
+    def test_subset_rejected_for_non_gram_rules(self):
+        plan = plan_gradient_attack_fold(
+            "lie", core.default_byz_mask(N, F)
+        )
+        with pytest.raises(ValueError, match="gram_select"):
+            folded_tree_aggregate(
+                gars["median"], plan, _stacked_tree(jax.random.PRNGKey(2)),
+                f=F, subset_sel=jnp.arange(N - 1),
+            )
 
     def test_matches_where_path_nonstandard_mask(self):
         """Byzantine rows need not be the trailing slots."""

@@ -59,11 +59,16 @@ class TestAggregathor:
     @pytest.mark.parametrize("gar,attack,f,subset", [
         ("krum", "lie", 2, None),
         ("krum", "reverse", 2, None),
-        # subset=7 is a TRIPWIRE: today the gate sends BOTH flag values down
-        # the flat path (trivially equal); if tree-mode subset selection is
-        # ever re-enabled, this row becomes a real tree-vs-flat equivalence
-        # check on the per-subset key derivation.
+        # subset=7 with a Gram-form rule: r5's sub-Gram composition keeps
+        # the tree/fold fast path under true wait-n-f subsets — this row
+        # is a REAL tree-vs-flat equivalence check on the per-subset key
+        # derivation (it was a tripwire while subsets forced both paths
+        # flat).
         ("krum", "reverse", 2, 7),
+        ("krum", "lie", 2, 7),  # the extra-row fold composed with subset
+        ("brute", "lie", 2, None),
+        ("aksel", "reverse", 2, None),
+        ("condense", "lie", 2, None),
         # subset == n never selects rows and stays tree-eligible: this row
         # genuinely compares tree vs flat.
         ("krum", "reverse", 2, 8),
