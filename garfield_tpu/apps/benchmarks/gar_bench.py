@@ -83,7 +83,16 @@ def bench_one(gar, n, f, d, reps, key):
         np.asarray(s[0, :1])  # host readback: the only reliable sync
         return time.perf_counter() - t0
 
-    return profiling.paired_reps(timed, reps)
+    # Two-phase adaptive timing (VERDICT r4 weak #2): sub-ms cells at the
+    # configured reps leave the chained run far below the host-sync noise
+    # floor, and their committed values bounced >1.3x between sweeps. A
+    # coarse estimate sizes reps so the timed chain runs ~0.5 s, then the
+    # recorded value is the MIN over all pairs (co-tenant interference
+    # only adds time; the minimum estimates the kernel itself).
+    est = profiling.paired_reps(timed, reps, pairs=2)
+    if est is not None and est * reps < 0.25:
+        reps = min(4000, max(reps, int(0.5 / max(est, 1e-7))))
+    return profiling.paired_reps(timed, reps, pairs=4, agg="min")
 
 
 def main(argv=None):

@@ -1,0 +1,127 @@
+"""Window-proof headline step benchmark (VERDICT r4 weak #1 / #3).
+
+Measures the BASELINE.md rule x attack rows in ONE process, interleaving
+every configuration with the fault-free floor (average/f0) in ABAB rounds,
+and reports each row as BOTH an absolute ms/step and a RATIO to the
+same-round floor — the ratio survives the shared chip's co-tenant windows
+(measured 49.5 vs 81.5 steps/s within one hour), absolute numbers from
+different windows do not. Run:
+
+    cd /root/repo && python scripts/step_bench.py --json STEPBENCH.json
+
+North-star shape: ResNet-18/CIFAR-10, 8 workers x batch 25, bf16 pipeline
+(the bench.py config; Aggregathor/trainer.py:231-249 is the step being
+measured).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, ".")
+
+from garfield_tpu import models  # noqa: E402
+from garfield_tpu.parallel import aggregathor  # noqa: E402
+from garfield_tpu.utils import profiling, selectors  # noqa: E402
+
+ROWS = [
+    ("average", None, 0),
+    ("krum", None, 2),
+    ("krum", "lie", 2),
+    ("median", "lie", 2),
+    ("tmean", "lie", 2),
+    ("bulyan", "lie", 1),
+    ("cclip", "lie", 2),
+    ("cclip", None, 2),
+]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--json", type=str, default=None)
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--reps", type=int, default=40)
+    args = p.parse_args(argv)
+
+    profiling.enable_compile_cache()
+    N, B = 8, 25
+    module = models.select_model("resnet18", "cifar10", dtype=jnp.bfloat16)
+    loss_fn = selectors.select_loss("nll")
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((N, B, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (N, B)), jnp.int32)
+
+    def build(gar, attack, f):
+        init_fn, step_fn, _ = aggregathor.make_trainer(
+            module, loss_fn, optax.sgd(0.1), gar,
+            num_workers=N, f=f, attack=attack, gar_dtype=jnp.bfloat16,
+        )
+        box = [init_fn(jax.random.PRNGKey(5), x[0])]
+        box[0], m = step_fn(box[0], x, y)
+        jax.block_until_ready(box[0].step)
+
+        def run(reps):
+            t0 = time.time()
+            for _ in range(reps):
+                box[0], m = step_fn(box[0], x, y)
+            float(jnp.asarray(m["loss"]).sum())
+            return time.time() - t0
+
+        return run
+
+    floor_run = build("average", None, 0)
+    runs = {
+        (g, a, f): build(g, a, f) for (g, a, f) in ROWS if g != "average"
+    }
+    results = {key: [] for key in [("average", None, 0), *runs]}
+    floors = []
+    for rnd in range(args.rounds):
+        # Interleave: floor first, then every row, so each row has a
+        # same-round floor to ratio against.
+        fl = profiling.paired_reps(floor_run, args.reps, pairs=2)
+        floors.append(fl)
+        results[("average", None, 0)].append(fl)
+        for key, run in runs.items():
+            ms = profiling.paired_reps(run, args.reps, pairs=2)
+            results[key].append(ms)
+            g, a, f = key
+            print(
+                f"round {rnd} {g}+{a or 'none'}/f{f}: "
+                + (f"{ms*1e3:.2f} ms ({1/ms:.1f}/s), "
+                   f"ratio {ms/fl:.3f}x floor" if ms and fl else "n/a"),
+                flush=True,
+            )
+    out = []
+    for (g, a, f), vals in results.items():
+        vals = [v for v in vals if v]
+        if not vals:
+            continue
+        best = min(vals)
+        ratios = [
+            v / fl for v, fl in zip(results[(g, a, f)], floors)
+            if v and fl
+        ]
+        out.append({
+            "gar": g, "attack": a, "f": f,
+            "ms_per_step_best": round(best * 1e3, 2),
+            "steps_per_s_best": round(1 / best, 1),
+            "ratio_vs_floor_median": (
+                round(float(np.median(ratios)), 3) if ratios else None
+            ),
+        })
+    for row in out:
+        print(json.dumps(row), flush=True)
+    if args.json:
+        with open(args.json, "w") as fp:
+            json.dump(out, fp, indent=1)
+    return out
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
