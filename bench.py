@@ -118,11 +118,38 @@ def _measure(step_fn, init_fn, x, y, steps):
 
 
 def main():
+    """Entry point: run the benchmark, emitting ONE JSON line no matter
+    what. A dead backend or any uncaught error becomes a parseable
+    ``{"error": ...}`` object instead of a hang or a traceback (VERDICT r5
+    #1a: BENCH_r05 died rc=1 with ``parsed: null`` when the TPU tunnel was
+    down at capture time)."""
+    try:
+        _main_impl()
+    except Exception as e:  # noqa: BLE001 — the JSON contract is total
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        sys.exit(0)
+
+
+def _main_impl():
     import optax
 
     from garfield_tpu import models
     from garfield_tpu.parallel import aggregathor, mesh as mesh_lib
     from garfield_tpu.utils import profiling, selectors
+
+    # Never initialize the default backend in-process first: with the TPU
+    # tunnel down, jax.devices() blocks forever inside plugin init. Probe
+    # the device count in a short-timeout subprocess and fall back to the
+    # CPU platform on any failure — the run still emits a parseable line
+    # (flagged non-official by the platform guard below).
+    if os.environ.get("GARFIELD_FORCE_CPU_DRYRUN"):
+        jax.config.update("jax_platforms", "cpu")
+    elif profiling.probe_device_count() is None:
+        print(
+            "bench: backend probe failed or timed out; falling back to CPU",
+            file=sys.stderr,
+        )
+        jax.config.update("jax_platforms", "cpu")
 
     # Persistent compile cache: a retry (or driver re-run) after a transient
     # tunnel failure must not re-enter the full-recompile flake window.

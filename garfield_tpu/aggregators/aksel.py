@@ -89,12 +89,21 @@ def fold_flat_aggregate(ext_stack, row_map, row_scale, f=0, key=None,
     rmap = np_.asarray(row_map)
     scales = np_.asarray(row_scale, np_.float32)
     n = rmap.size
-    med = ops.coordinate_median(
-        ext_stack, row_map=rmap, row_scale=scales
-    ).astype(jnp.float32)
+    med = ops.coordinate_median(ext_stack, row_map=rmap, row_scale=scales)
+    med32 = med.astype(jnp.float32)
     finite = jnp.isfinite(ext_stack)
     x_safe = jnp.where(finite, ext_stack, 0)
-    dev = x_safe.astype(jnp.float32) - med[None, :]
+    # Subtract in the STACK dtype and upcast only for the square (ADVICE
+    # r5 #3): the flat/tree paths compute (g - med) in the input dtype
+    # before the f32 cast, so a f32 subtraction here would round the sort
+    # keys differently under a bf16 pipeline and rank near-tied rows
+    # differently — the same quantize-before-square rule as
+    # ops._avgmed_kernel's ``quant_dtype``. Unit-scale rows (every row of
+    # the lie/empire/crash folds) now match the where-path bitwise; the
+    # additive expansion for exotic scales below stays f32 (its where-path
+    # counterpart materializes scaled rows, which no dtype choice here can
+    # reproduce exactly — it is selection-equivalent away from exact ties).
+    dev = (x_safe - med.astype(ext_stack.dtype)[None, :]).astype(jnp.float32)
     nsq_direct = jnp.sum(dev * dev, axis=1)
     unit_mask = scales == 1.0
     if bool(unit_mask.all()):
@@ -102,12 +111,12 @@ def fold_flat_aggregate(ext_stack, row_map, row_scale, f=0, key=None,
     elif bool((scales[~unit_mask] == 0.0).all()):
         # Only zero scales besides units (the crash fold): the expansion
         # degenerates to ||med||^2 — skip the sq/dot stack passes.
-        msq = jnp.sum(med * med)
+        msq = jnp.sum(med32 * med32)
         dist = jnp.where(jnp.asarray(unit_mask), nsq_direct[rmap], msq)
     else:
         sq = jnp.sum(jnp.square(x_safe.astype(jnp.float32)), axis=1)
-        dot = jnp.sum(x_safe.astype(jnp.float32) * med[None, :], axis=1)
-        msq = jnp.sum(med * med)
+        dot = jnp.sum(x_safe.astype(jnp.float32) * med32[None, :], axis=1)
+        msq = jnp.sum(med32 * med32)
         s = jnp.asarray(scales)
         dist = jnp.where(
             jnp.asarray(unit_mask),

@@ -78,8 +78,14 @@ def aggregate(gradients, f=0, key=None, center=None, tau=None,
     eps = jnp.asarray(1e-12, jnp.float32)
     if center is None:
         # NaN-last lower median (jnp.median would propagate a poisoned
-        # row's NaN into every coordinate of the init).
-        center = coordinate_median(stack)
+        # row's NaN into every coordinate of the init). Cast to f32 so
+        # _clip_step's subtraction runs at the SAME width as the folded
+        # path's (which computes radii from f32 deviations) and as the
+        # carried-center production config (TrainState.gar_state is f32)
+        # — under a bf16 pipeline a stack-dtype subtraction here rounded
+        # the tau median differently from the fold on the very first
+        # standalone step (ADVICE r5 #5).
+        center = coordinate_median(stack).astype(jnp.float32)
     for _ in range(iters):
         center = _clip_step(stack, center, tau, eps)
     return center
@@ -131,6 +137,15 @@ def fold_flat_aggregate(ext_stack, row_map, row_scale, f=0, key=None,
     the where-path exactly for fully-poisoned rows like the fw=1 lie NaN
     fake; the flat path's entry-level guard differs only for PARTIALLY
     non-finite rows, a regime no deterministic attack produces).
+
+    bf16 drift note (ADVICE r5 #5): both paths now SUBTRACT at f32 (the
+    where-path casts its median init to f32, and carried centers are f32
+    by construction), so the radii agree to f32 rounding — but the update
+    reductions still associate differently (this path's weighted matvec
+    accumulates bf16 rows into f32; the where-path means f32 deviations),
+    so under a bf16 pipeline the two trajectories agree only to bf16
+    rounding, not bitwise. Exact-parity tests pin f32; the bf16 row in
+    tests/test_fold.py pins the agreed tolerance.
     """
     import numpy as np
 
@@ -158,11 +173,12 @@ def fold_flat_aggregate(ext_stack, row_map, row_scale, f=0, key=None,
     if center is None:
         # Remapped-row Pallas median: the robust init sees the POISONED
         # logical rows without them ever existing (ops row_map/row_scale).
+        # f32, mirroring the where-path's init cast (see `aggregate`).
         from .. import ops
 
         center = ops.coordinate_median(
             ext_stack, row_map=rmap, row_scale=scales
-        )
+        ).astype(jnp.float32)
     bad_log = row_bad[rmap] & (s_log != 0)
     v = center
     for _ in range(iters):

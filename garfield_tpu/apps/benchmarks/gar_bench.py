@@ -72,10 +72,12 @@ def bench_one(gar, n, f, d, reps, key):
         lambda s: s.at[0].set(gar.unchecked(s, **kwargs).astype(s.dtype)),
         donate_argnums=0,
     )
-    s0_host = np.asarray(chain(g))  # compile + warm + sync (g donated)
+    # np.array/jnp.array (not asarray): on CPU an asarray view would alias
+    # the device buffer the next chain() call donates, corrupting s0_host.
+    s0_host = np.array(chain(g))  # compile + warm + sync (g donated)
 
     def timed(k):
-        s = jnp.asarray(s0_host)
+        s = jnp.array(s0_host)
         np.asarray(s[0, :1])  # finish H2D transfer + drain queue
         t0 = time.perf_counter()
         for _ in range(k):

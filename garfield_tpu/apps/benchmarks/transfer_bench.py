@@ -40,7 +40,7 @@ def bench_gather(mesh, d, reps):
         )
 
     fn = jax.jit(
-        jax.shard_map(
+        mesh_lib.shard_map(
             gather_fold, mesh=mesh, in_specs=P(axis), out_specs=P(axis)
         )
     )

@@ -34,6 +34,13 @@ def main(argv=None):
              "ByzSGD/trainer.py:34 note).",
     )
     parser.add_argument(
+        "--model_subset", type=int, default=None,
+        help="Per-PS wait-n-f on the MODEL gather: each PS aggregates its "
+             "own seeded fastest q_m peer models. Pass num_ps - fps for "
+             "exact protocol parity with get_models(num_ps - fps) "
+             "(ByzSGD/trainer.py:240-242); unset aggregates all.",
+    )
+    parser.add_argument(
         "--cluster", type=str, default=None,
         help="Cluster config JSON: run as ONE process of a multi-process "
              "MSMW deployment over PeerExchange — every PS a real process "
@@ -69,6 +76,7 @@ def main(argv=None):
             ps_attack=args.ps_attack,
             ps_attack_params=args.ps_attack_params,
             subset=args.subset,
+            model_subset=args.model_subset,
             model_gar=args.model_gar,
         ),
         num_slots=args.num_workers,

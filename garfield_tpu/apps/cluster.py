@@ -560,6 +560,16 @@ class _ModelPlane:
     FULL original rank list — sends to a dead rank cost one bounded queue
     (exchange per-peer senders), while excluding a merely-slow rank from
     the fan-out would starve it into a real partition.
+
+    Drops are NOT permanent (r6, ADVICE r5 #1): every timeout probe also
+    reads the dropped ranks' newest rounds, and a dropped rank whose round
+    ADVANCES again is re-admitted (``readmit``) with the tolerance
+    restored by the same ``_shrink_fps`` feasibility walk. A healthy
+    replica falsely dropped during a multi-minute eval/compile pause
+    rejoins the plane the first time any observer next times out, instead
+    of fragmenting the deployment into asymmetric plane compositions
+    forever. (Re-admission is per-observer, like the drop — each process
+    converges on the set of peers IT observes making progress.)
     """
 
     def __init__(self, ps_ranks, model_gar_name, fps, who):
@@ -601,6 +611,26 @@ class _ModelPlane:
             f"{self.gar_name!r} at fps={self.fps}"
         )
 
+    def dropped(self):
+        return [r for r in self.all_ranks if r not in self.ranks]
+
+    def readmit(self, rank):
+        """Restore a previously dropped rank whose round advanced again
+        (it was paused, not dead); tolerance re-grows by the same
+        feasibility walk the drop shrank it with."""
+        if rank in self.ranks:
+            return
+        self.ranks = sorted(self.ranks + [rank])
+        self.gar_name, self.fps = _shrink_fps(
+            self.base_gar, len(self.ranks), self.base_fps
+        )
+        self._stalls[rank] = 0
+        tools.warning(
+            f"[{self.who}] model plane re-admitted rank {rank} (round "
+            f"progress observed after a drop); {len(self.ranks)} replicas, "
+            f"model GAR {self.gar_name!r} at fps={self.fps}"
+        )
+
 
 @functools.lru_cache(maxsize=16)
 def _jit_model_agg(name, f2):
@@ -639,7 +669,9 @@ def _collect_models(ex, step, plane, timeout_ms, expect_bytes):
     progress-based liveness: each silent slot is probed for its newest
     round at ANY step (``read_latest(r, 0)``); a peer whose newest round
     advanced is alive (merely slow/behind — keep waiting), a peer with
-    no advance across two timeout cycles is dropped, and a probe that
+    no advance across two timeout cycles is dropped (and RE-ADMITTED by a
+    later probe that sees its round advancing — _ModelPlane.readmit), and
+    a probe that
     reveals the plane has MOVED AHEAD of ``step`` (this caller resumed
     or straggled behind its peers) raises ``_Lapped`` so the caller can
     jump. Raises TimeoutError only when every peer slot is silent.
@@ -671,8 +703,27 @@ def _collect_models(ex, step, plane, timeout_ms, expect_bytes):
                     newest = max(newest, s)
                 except TimeoutError:
                     plane.note_progress(r, -1)
+            # Dropped ranks are probed too (ADVICE r5 #1): a drop is a
+            # liveness HYPOTHESIS, and a dropped rank whose newest round
+            # advanced has refuted it — re-admit it so a falsely-dropped
+            # replica (multi-minute eval/compile pause) rejoins instead of
+            # fragmenting the plane permanently. Publishing never stopped
+            # fanning out to it, so it kept receiving frames all along.
+            readmitted = False
+            for r in plane.dropped():
+                try:
+                    s, _ = ex.read_latest(r, 0, timeout_ms=2_000)
+                except TimeoutError:
+                    continue
+                if plane.note_progress(r, s):
+                    plane.readmit(r)
+                    newest = max(newest, s)
+                    readmitted = True
             if newest > step:
                 raise _Lapped(newest)
+            if readmitted:
+                attempts = 0
+                continue  # retry the collect over the restored plane
             dead = [
                 r for r in plane.ranks
                 if r != ex.my_index and plane.stalled_out(r)
@@ -724,11 +775,13 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
 
     r5 (VERDICT r4 #4/#7):
       - BatchNorm statistics travel on BOTH planes like SSMW: gradient
-        frames are [grad || stats], model frames [params || stats]; the
-        PS robust-aggregates its quorum's stats (f budget) and every node
-        robust-aggregates the PS stats (fps budget), so MSMW deployments
-        stop silently drifting on BN architectures
-        (ByzSGD/trainer.py:240-244 never ships buffers).
+        frames are [grad || stats], model frames [params || stats]; each
+        replica blends the model-plane stats aggregate (fps budget) with
+        its own worker quorum's stats (f budget) at equal weight — the
+        same reconcile-then-refresh shape as the params — so MSMW
+        deployments stop silently drifting on BN architectures
+        (ByzSGD/trainer.py:240-244 never ships buffers; workers still
+        robust-aggregate the PS stats on their side).
       - Checkpoint/resume: each replica saves under
         checkpoint_dir/ps_{pindex}; a replica that resumes behind its
         peers catches up via the model plane (_Lapped: jump to the
@@ -849,7 +902,11 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
             plane.aggregate(models[:, : flat.size])
         )
         if bn_bytes:
-            bn = _robust_stats(models[:, flat.size:], plane.fps)
+            # Model-plane BN aggregate (fps budget) — BLENDED with the
+            # worker quorum's stats below, not overwritten (ADVICE r5 #2:
+            # the old assignment here was dead, so replicas never actually
+            # reconciled BN state).
+            bn_plane = _robust_stats(models[:, flat.size:], plane.fps)
         got, good_ranks = _gradient_quorum(
             ex, i, q, good_ranks, d_bytes + bn_bytes,
             lambda: ex.publish(i, frame, to=everyone),
@@ -860,9 +917,18 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
         ]
         rows = [fr[: flat.size] for fr in frames]
         if bn_bytes:
-            bn = _robust_stats(
+            # BN reconciliation mirrors the params: equal-weight blend of
+            # the peer replicas' robust-aggregated stats (published next
+            # round) with this quorum's fresh worker stats. Replicas see
+            # overlapping-but-different worker quorums, so without the
+            # plane term their BN states drift apart unboundedly; the 1/2
+            # contraction bounds the spread at O(one quorum's dispersion)
+            # while still tracking the live statistics (the on-mesh twin's
+            # pmean over the ps axis, parallel/byzsgd.py, is the
+            # limit-case of this blend).
+            bn = 0.5 * (bn_plane + _robust_stats(
                 np.stack([fr[flat.size:] for fr in frames]), f
-            )
+            ))
         flat_dev, opt_state = ps_update(
             flat_dev, opt_state, jnp.asarray(np.stack(rows)),
             jnp.asarray(i, jnp.int32),
@@ -1096,21 +1162,25 @@ def _run_learn(args):
             start_iter = int(step0)
             print(f"[{who}] resumed from step {start_iter}", flush=True)
     try:
-        # Startup rendezvous (r5 redesign): the hello at step 0 (published
-        # the moment the exchange exists, before data/model init) is a
-        # cheap config-error barrier; the REAL rendezvous is round
-        # ``start_iter``'s own quorum, whose waiters are pre-registered
-        # BEFORE the jit warmup — ``collect_begin`` latches frames in the
-        # blocked readers, so however long this node (or any peer)
-        # compiles, no round frame can age out of the last-writer-wins
-        # register. The first round's budget gets a generous startup
-        # ceiling (env GARFIELD_STARTUP_TIMEOUT_MS, default 30 min):
-        # co-located nodes compile ResNet-class programs nearly serially
-        # on a small host, and the timeout only bounds how long a
-        # genuinely dead peer can stall startup — it costs nothing when
-        # everyone arrives. (An earlier warmup-then-barrier design gated
-        # round 0 on a fixed post-warmup budget; asymmetric compile/cache
-        # skew blew it reproducibly.)
+        # Startup rendezvous (r5 redesign; comment corrected r6, ADVICE r5
+        # #4): the hello at step 0 (published the moment the exchange
+        # exists, before data/model init) is a cheap config-error barrier.
+        # Safety against compile skew comes from the READY barrier below —
+        # no node starts round ``start_iter`` before every peer has
+        # finished its jit warmup — plus the waiter ordering: round
+        # ``start_iter``'s waiters are registered BEFORE this node
+        # publishes its own ready beacon, so by the time any peer can see
+        # the full barrier (our beacon included) and publish its first
+        # frame, our ``collect_begin`` readers are already latched and no
+        # round frame can age out of the last-writer-wins register. The
+        # barrier's read budget is a generous startup ceiling (env
+        # GARFIELD_STARTUP_TIMEOUT_MS, default 30 min): co-located nodes
+        # compile ResNet-class programs nearly serially on a small host,
+        # and the timeout only bounds how long a genuinely dead peer can
+        # stall startup — it costs nothing when everyone arrives. (An
+        # earlier warmup-then-barrier design gated round 0 on a fixed
+        # post-warmup budget; asymmetric compile/cache skew blew it
+        # reproducibly.)
         startup_ms = _startup_ms(args)
         deadline = time.monotonic() + startup_ms / 1e3
 
@@ -1156,17 +1226,14 @@ def _run_learn(args):
         dummy = jnp.zeros((q, flat.size), jnp.float32)
         node_update(flat_dev, opt_state, dummy, jnp.asarray(0, jnp.int32))
         model_aggregate(dummy, jnp.asarray(0, jnp.int32))
-        ex.publish(1, b"ready")
-        deadline = time.monotonic() + startup_ms / 1e3  # re-arm for stage 2
-        for r in range(n):
-            if r != me:
-                await_beacon(r, 1, b"ready", "ready beacon")
 
         def register_round(i):
             """Pre-register BOTH phases' waiters before any local work —
             frames arriving while this node computes (or evaluates) are
             latched by the blocked readers and cannot be overwritten away
-            (exchange.collect_begin docstring)."""
+            (exchange.collect_begin docstring; its timeout clock starts at
+            wait(), so registering before the ready barrier below cannot
+            eat the round budget)."""
             return (
                 ex.collect_begin(
                     2 * i + 2, q, timeout_ms=args.cluster_timeout_ms
@@ -1176,7 +1243,15 @@ def _run_learn(args):
                 ),
             )
 
+        # First round's waiters BEFORE our ready beacon (see the startup
+        # comment above): a peer can only start publishing rounds after it
+        # has seen this beacon, at which point our readers already latch.
         grad_wait, model_wait = register_round(start_iter)
+        ex.publish(1, b"ready")
+        deadline = time.monotonic() + startup_ms / 1e3  # re-arm for stage 2
+        for r in range(n):
+            if r != me:
+                await_beacon(r, 1, b"ready", "ready beacon")
         for i in range(start_iter, args.num_iter):
             # --- gradient plane (phase 2i+2) -----------------------------
             if atk_kind == "cohort":

@@ -38,8 +38,10 @@ __all__ = [
     "apply_gradient_attack",
     "apply_gradient_attack_tree",
     "apply_model_attack",
+    "apply_model_attack_rows",
     "GradientAttackFold",
     "plan_gradient_attack_fold",
+    "plan_model_attack_fold",
 ]
 
 
@@ -306,6 +308,33 @@ def plan_gradient_attack_fold(attack, byz_mask, *, z=LIE_Z, eps=EMPIRE_EPS,
     return None
 
 
+def plan_model_attack_fold(attack, byz_mask, *, factor=-100.0, **_):
+    """Folded plan for the DETERMINISTIC model attacks, or None.
+
+    byzServer's reverse (model * -100, :93-98) and the crash fault are pure
+    per-row scalings with no cohort statistics and no shared fake row, so
+    their ``GradientAttackFold`` is an identity row map with scales — the
+    Gram-remap machinery of ``parallel.fold`` applies to model-plane
+    exchanges (LEARN gossip, ByzSGD gather step) unchanged. Randomized
+    model attacks (random, drop) keep the where-path. Same
+    ``GARFIELD_NO_FOLD`` escape hatch as the gradient plans."""
+    import os
+
+    import numpy as np
+
+    if attack is None or attack == "none" or os.environ.get("GARFIELD_NO_FOLD"):
+        return None
+    mask = np.asarray(byz_mask, dtype=bool)
+    if not mask.any():
+        return None
+    identity = np.arange(mask.size)
+    if attack == "reverse":
+        return GradientAttackFold(identity, np.where(mask, factor, 1.0))
+    if attack == "crash":
+        return GradientAttackFold(identity, np.where(mask, 0.0, 1.0))
+    return None
+
+
 # --- model attacks (byzServer.py:86-108) -----------------------------------
 
 
@@ -355,3 +384,30 @@ def apply_model_attack(attack, model_vec, *, key=None, **params):
             raise ValueError(f"model attack {attack!r} needs a PRNG key")
         return fn(model_vec, key=key, **params)
     return fn(model_vec, **params)
+
+
+def apply_model_attack_rows(attack, models, byz_mask, *, key=None, **params):
+    """Poison the Byzantine ROWS of a gathered (n, d) model stack.
+
+    The stack form of ``apply_model_attack`` shared by the model planes
+    (LEARN gossip, ByzSGD gather step): row i is attacked with the key
+    folded by its GLOBAL row index, so every shard derives identical
+    draws for the randomized attacks. None/"none" is passthrough.
+    """
+    if attack is None or attack == "none":
+        return models
+    if attack not in model_attacks:
+        raise ValueError(
+            f"unknown model attack {attack!r}; available: {sorted(model_attacks)}"
+        )
+    fn = model_attacks[attack]
+    n = models.shape[0]
+    if fn in (model_random_attack, model_drop_attack):
+        if key is None:
+            raise ValueError(f"model attack {attack!r} needs a PRNG key")
+        poisoned = jax.vmap(
+            lambda i, m: fn(m, key=jax.random.fold_in(key, i), **params)
+        )(jnp.arange(n), models)
+    else:
+        poisoned = jax.vmap(lambda m: fn(m, **params))(models)
+    return jnp.where(jnp.asarray(byz_mask, bool)[:, None], poisoned, models)

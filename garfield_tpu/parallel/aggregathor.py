@@ -403,7 +403,7 @@ def make_trainer(
         )
         return new_state, {"loss": mean_loss}
 
-    sharded_step = jax.shard_map(
+    sharded_step = mesh_lib.shard_map(
         _local_step,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis)),
@@ -414,7 +414,7 @@ def make_trainer(
     @functools.partial(
         jax.jit,
         out_shardings=(repl, repl),
-        donate_argnums=(0,),
+        donate_argnums=core.step_donation(),
     )
     def step_fn(state, x, y):
         return sharded_step(state, x, y)

@@ -19,12 +19,17 @@ server state is stacked over the "ps" axis, worker batches are sharded over
     params[i]   = opt(params[i], aggr[i])                  # update_model
     models      = all_gather(flat(params), "ps")           # get_models, :161-184
     models      = model_attack(models, byz_ps)             # byzServer.py:86-108
-    params[i]   = unflat(gar(models, f_ps))                # write_model, :289-297
+    params[i]   = unflat(gar(models[msubset_i], f_ps))     # write_model, :289-297
 
 Honest-PS divergence (the reason model aggregation exists at all) arises here
 from per-PS wait-n-f subsets — each PS samples its *own* q of n gradients,
 mirroring different arrival orders at different servers in the async
-reference.
+reference. ``model_subset`` extends the same emulation to the model gather:
+the reference's gather step pulls only the fastest ``num_ps - fps`` peer
+models (``get_models(num_ps - fps)``, trainer.py:240-242), so each PS
+aggregates its own seeded model subset — composed onto the model Gram for
+Gram-form rules, with deterministic PS attacks folded into the Gram remap
+(fold.plan_for_model).
 
 ``worker_momentum`` (aggregathor/learn) is deliberately NOT offered here:
 in this topology every PS slot evaluates the workers' batches against its
@@ -46,7 +51,7 @@ from .. import aggregators
 from ..attacks import (
     apply_gradient_attack,
     apply_gradient_attack_tree,
-    apply_model_attack,
+    apply_model_attack_rows,
     model_attacks,
 )
 from . import core, fold, mesh as mesh_lib
@@ -75,6 +80,7 @@ def make_trainer(
     axis="workers",
     ps_axis="ps",
     subset=None,
+    model_subset=None,
     model_gar=None,
     granularity="model",
     tree_path=True,
@@ -89,6 +95,21 @@ def make_trainer(
     (default: same rule) aggregates server models with tolerance ``fps`` —
     the reference uses one GAR for both (ByzSGD/trainer.py:34 note).
     ``subset=q`` gives each PS its own sampled wait-for-q gradient subset.
+    ``model_subset=q_m`` gives each PS its own sampled wait-for-q_m subset
+    of the MODEL gather too — the reference-faithful semantics
+    (``get_models(num_ps - fps)``, ByzSGD/trainer.py:240-242 /
+    server.py:161-184: a server aggregates the fastest ``num_ps - fps``
+    peer models, never all of them — pass ``q_m = num_ps - fps`` for exact
+    protocol parity). With it, honest PS replicas hold genuinely DIFFERENT
+    post-gather models (the async reality the broadcast-one-aggregate
+    default hides); the contraction of the model GAR is what keeps them
+    from drifting apart. The subset composes onto the model Gram for
+    Gram-form rules (one (n_ps, n_ps) Gram build, per-PS (q_m, q_m)
+    sub-Gram selections — the same fast-path composition as the gradient
+    plane; ``tree_path=False`` forces the flat per-PS gathers), and the
+    deterministic model attacks (reverse/crash) fold into the Gram remap
+    (``fold.plan_for_model``). None (default) keeps the aggregate-all
+    behavior.
     ``granularity="layer"`` applies both GARs independently per parameter
     tensor — the Garfield_CC GuanYu semantics (its reduce_gradients loops
     over model layers, Garfield_CC/trainer.py:55-204) — by segmenting the
@@ -141,8 +162,17 @@ def make_trainer(
     _check_gar(gar, n_eff, fw)
     per_w = mesh_lib.fold(num_workers, mesh.shape[axis], "workers")
     per_ps = mesh_lib.fold(num_ps, mesh.shape[ps_axis], "servers")
+    if model_subset is not None and not (1 <= model_subset <= num_ps):
+        raise ValueError(
+            f"model_subset (wait-for-q models) must be in [1, {num_ps}], "
+            f"got {model_subset}"
+        )
+    # The model GAR sees model_subset rows when waiting (the reference
+    # passes the num_ps - fps received models straight to the rule,
+    # ByzSGD/trainer.py:240-242).
+    m_eff = model_subset if model_subset is not None else num_ps
     if num_ps > 1 or fps:
-        _check_gar(model_gar, num_ps, fps)
+        _check_gar(model_gar, m_eff, fps)
     if ps_attack is not None and ps_attack != "none" and ps_attack not in model_attacks:
         raise ValueError(f"unknown model attack {ps_attack!r}")
     if byz_worker_mask is None:
@@ -152,8 +182,22 @@ def make_trainer(
     # Folded attack plan for the gradient phase: static for deterministic
     # attacks on fold-capable rules (see fold.plan_for); None -> where-path.
     fold_plan = fold.plan_for(gar, attack, byz_worker_mask, attack_params)
+    # Model-plane twin: byzServer's reverse/crash are pure row scalings, so
+    # under per-PS model subsets the poisoned model Gram is a static outer
+    # scaling of the raw one (fold.plan_for_model); None -> where-path.
+    model_fold_plan = fold.plan_for_model(
+        model_gar, ps_attack, byz_ps_mask, ps_attack_params
+    )
     byz_worker_mask = jnp.asarray(byz_worker_mask, bool)
     byz_ps_mask = jnp.asarray(byz_ps_mask, bool)
+    model_waiting = model_subset is not None and model_subset < num_ps
+    # Per-PS model subsets compose onto the model Gram for Gram-form rules
+    # (the gradient plane's sub-Gram fast path applied to the (n_ps, d)
+    # model stack); other rules gather per-PS rows on the flat path.
+    model_gram_ok = (
+        tree_path and model_gar.gram_select is not None
+        and granularity != "layer"
+    )
 
     init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
     # Slot-fused gradient twin (models/slotfused.py) — worker slots share
@@ -217,7 +261,7 @@ def make_trainer(
     def _local_step(state, x_local, y_local):
         base = jax.random.fold_in(state.rng, state.step)
         (atk_key, sub_key, psatk_key, drop_base,
-         gar_key, mgar_key) = jax.random.split(base, 6)
+         gar_key, mgar_key, msub_key) = jax.random.split(base, 7)
         ps_shard = jax.lax.axis_index(ps_axis)
         w_shard = jax.lax.axis_index(axis)
         ps_ids = ps_shard * per_ps + jnp.arange(per_ps)
@@ -309,31 +353,90 @@ def make_trainer(
         # --- model gather phase (ByzSGD/trainer.py:240-244) ----------------
         flat_models = core.flatten_rows(new_params)  # (per_ps, d)
         models = jax.lax.all_gather(flat_models, ps_axis, tiled=True)  # (n_ps, d)
-        poisoned = jax.vmap(
-            lambda i, m: apply_model_attack(
-                ps_attack, m, key=jax.random.fold_in(psatk_key, i),
-                **ps_attack_params,
-            )
-        )(jnp.arange(num_ps), models)
-        models = jnp.where(byz_ps_mask[:, None], poisoned, models)
         params0 = jax.tree.map(lambda l: l[0], new_params)
-        if granularity == "layer":
-            aggr_model = core.segmented_aggregate(
-                lambda s, i: model_gar.unchecked(
-                    s, f=fps, key=jax.random.fold_in(mgar_key, i),
-                    **model_gar_params,
-                ),
-                models,
-                core.leaf_segments(params0),
+        if model_waiting:
+            # Reference-faithful wait-n-f on the model plane: each PS
+            # aggregates only its own seeded fastest q_m peer models
+            # (get_models(num_ps - fps), trainer.py:240-242 /
+            # server.py:161-184) — honest replicas genuinely DIVERGE here;
+            # the model GAR's contraction, not a broadcast, holds them
+            # together. Same per-observer composition as the gradient
+            # plane: for Gram-form rules ONE model Gram serves every local
+            # PS slot via (q_m, q_m) sub-Gram selections, with
+            # deterministic PS attacks (reverse/crash) folded into the
+            # Gram remap instead of poisoning the rows.
+            sels = jax.vmap(
+                lambda i: core.subset_indices(
+                    jax.random.fold_in(msub_key, i), num_ps, model_subset
+                )
+            )(ps_ids)
+            mkeys = jax.vmap(
+                lambda i: jax.random.fold_in(mgar_key, i)
+            )(ps_ids)
+            if model_gram_ok:
+                base_models = models
+                if model_fold_plan is None:
+                    base_models = apply_model_attack_rows(
+                        ps_attack, models, byz_ps_mask, key=psatk_key,
+                        **ps_attack_params,
+                    )
+                aggr_models = fold.folded_tree_aggregate_multi(
+                    model_gar, model_fold_plan, base_models, f=fps,
+                    keys=mkeys, gar_params=model_gar_params,
+                    subset_sels=sels,
+                )  # (per_ps, d)
+            else:
+                poisoned = apply_model_attack_rows(
+                    ps_attack, models, byz_ps_mask, key=psatk_key,
+                    **ps_attack_params,
+                )
+
+                def one_ps(sel, mkey):
+                    sub = poisoned[sel]
+                    if granularity == "layer":
+                        return core.segmented_aggregate(
+                            lambda s, i: model_gar.unchecked(
+                                s, f=fps, key=jax.random.fold_in(mkey, i),
+                                **model_gar_params,
+                            ),
+                            sub,
+                            core.leaf_segments(params0),
+                        )
+                    return model_gar.unchecked(
+                        sub, f=fps, key=mkey, **model_gar_params
+                    )
+
+                aggr_models = jax.vmap(one_ps)(sels, mkeys)  # (per_ps, d)
+            new_params = jax.tree.map(
+                lambda *ls: jnp.stack(ls),
+                *[
+                    core.unflatten_like(params0, aggr_models[k])
+                    for k in range(per_ps)
+                ],
             )
         else:
-            aggr_model = model_gar.unchecked(
-                models, f=fps, key=mgar_key, **model_gar_params
+            models = apply_model_attack_rows(
+                ps_attack, models, byz_ps_mask, key=psatk_key,
+                **ps_attack_params,
             )
-        written = core.unflatten_like(params0, aggr_model)
-        new_params = jax.tree.map(
-            lambda l: jnp.broadcast_to(l[None], (per_ps,) + l.shape), written
-        )
+            if granularity == "layer":
+                aggr_model = core.segmented_aggregate(
+                    lambda s, i: model_gar.unchecked(
+                        s, f=fps, key=jax.random.fold_in(mgar_key, i),
+                        **model_gar_params,
+                    ),
+                    models,
+                    core.leaf_segments(params0),
+                )
+            else:
+                aggr_model = model_gar.unchecked(
+                    models, f=fps, key=mgar_key, **model_gar_params
+                )
+            written = core.unflatten_like(params0, aggr_model)
+            new_params = jax.tree.map(
+                lambda l: jnp.broadcast_to(l[None], (per_ps,) + l.shape),
+                written,
+            )
 
         # losses: (per_ps, per_w) — honest-worker mean, then over the mesh.
         honest = (~byz_worker_mask).astype(losses.dtype)
@@ -360,7 +463,7 @@ def make_trainer(
             {"loss": mean_loss},
         )
 
-    sharded_step = jax.shard_map(
+    sharded_step = mesh_lib.shard_map(
         _local_step,
         mesh=mesh,
         in_specs=(
@@ -381,7 +484,7 @@ def make_trainer(
         check_vma=False,
     )
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(jax.jit, donate_argnums=core.step_donation())
     def step_fn(state, x, y):
         return sharded_step(state, x, y)
 

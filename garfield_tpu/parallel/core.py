@@ -182,6 +182,26 @@ UNROLL_AMORTIZE_STEPS = int(
 )
 
 
+def step_donation():
+    """``donate_argnums`` for the topology step functions: ``(0,)`` (donate
+    the TrainState) on real device backends, ``()`` on XLA:CPU.
+
+    This jaxlib's CPU runtime executes donation unsoundly when host views
+    of the donated buffers are still alive — and on CPU both
+    ``np.asarray(jax_array)`` and ``jax.device_put(np_array)`` are
+    zero-copy, so checkpoint save/restore and the eval readback all
+    create such views. Observed in the warm-compile-cache app suite as
+    corrupted TrainState leaves (a resumed run's ``state.step`` reading
+    an eval count) and native SIGSEGV/SIGABRT mid-run. Donation is only
+    a memory-reuse optimization, so it is dropped on CPU; the device
+    backends keep it. ``GARFIELD_DONATE=0|1`` forces either choice.
+    """
+    forced = _os.environ.get("GARFIELD_DONATE", "").strip()
+    if forced in ("0", "1"):
+        return (0,) if forced == "1" else ()
+    return () if jax.default_backend() == "cpu" else (0,)
+
+
 def slot_path_decision(slots, num_iter=None, fused_available=False):
     """Pick the per-slot gradient formulation (VERDICT r4 #8).
 

@@ -53,18 +53,24 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..aggregators._common import tree_gram, tree_weighted_sum
-from ..attacks import plan_gradient_attack_fold
+from ..attacks import plan_gradient_attack_fold, plan_model_attack_fold
 
-__all__ = ["plan_for", "folded_tree_aggregate"]
+__all__ = [
+    "plan_for",
+    "plan_for_model",
+    "folded_tree_aggregate",
+    "folded_tree_aggregate_multi",
+]
 
 
 def plan_for(gar, attack, byz_mask, attack_params):
     """Single-sourced fold eligibility gate for the topology builders
-    (aggregathor AND byzsgd): a plan exists iff the rule has a fold-capable
-    form (``gram_select``, ``fold_aggregate``, or the coordinate-wise
-    ``tree_aggregate_ext``) and the attack folds (deterministic, with
-    actual Byzantine slots, and GARFIELD_NO_FOLD unset). ``byz_mask`` may
-    be any array-like; it must be concrete (the plan is static)."""
+    (aggregathor, byzsgd AND learn): a plan exists iff the rule has a
+    fold-capable form (``gram_select``, ``fold_aggregate``, or the
+    coordinate-wise ``tree_aggregate_ext``) and the attack folds
+    (deterministic, with actual Byzantine slots, and GARFIELD_NO_FOLD
+    unset). ``byz_mask`` may be any array-like; it must be concrete (the
+    plan is static)."""
     if (gar.gram_select is None and gar.fold_aggregate is None
             and gar.tree_aggregate_ext is None
             and gar.fold_flat_aggregate is None):
@@ -72,6 +78,37 @@ def plan_for(gar, attack, byz_mask, attack_params):
     return plan_gradient_attack_fold(
         attack, np.asarray(byz_mask, dtype=bool), **attack_params
     )
+
+
+def plan_for_model(gar, attack, byz_mask, attack_params):
+    """Fold gate for MODEL-plane exchanges (LEARN gossip, ByzSGD gather).
+
+    The deterministic model attacks (byzServer.py:93-98 reverse, the crash
+    fault) are pure per-row scalings — no cohort statistics, no shared fake
+    row — so their plan is an identity row map with scales and the same
+    Gram-remap machinery applies. Randomized model attacks (random, drop)
+    have no folded form and keep the where-path."""
+    if (gar.gram_select is None and gar.fold_aggregate is None
+            and gar.tree_aggregate_ext is None
+            and gar.fold_flat_aggregate is None):
+        return None
+    return plan_model_attack_fold(
+        attack, np.asarray(byz_mask, dtype=bool), **attack_params
+    )
+
+
+def _sanitize_gram(gram_p, row_scale):
+    """Force zero-scale (crash) rows/cols of a remapped Gram to exact
+    zeros. scale==0 means the poisoned row IS the zero vector, whose
+    inner products are exactly 0 — but 0 * inf = NaN if the raw row the
+    remap points at is non-finite, which the where-path cannot produce
+    (its literal zero row dots finitely). Static no-op when no scale is
+    zero, so lie/empire/reverse pay nothing."""
+    zero = np.asarray(row_scale) == 0
+    if not zero.any():
+        return gram_p
+    zmask = jnp.asarray(zero)
+    return jnp.where(zmask[:, None] | zmask[None, :], 0.0, gram_p)
 
 
 def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
@@ -124,17 +161,8 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
     center_tree = params.pop("center", None)
 
     def sanitize_gram(gram_p):
-        """Force zero-scale (crash) rows/cols of the remapped Gram to exact
-        zeros. scale==0 means the poisoned row IS the zero vector, whose
-        inner products are exactly 0 — but 0 * inf = NaN if the raw row the
-        remap points at is non-finite, which the where-path cannot produce
-        (its literal zero row dots finitely). Static no-op when no scale is
-        zero, so lie/empire/reverse pay nothing."""
-        zero = np.asarray(plan.row_scale) == 0
-        if not zero.any():
-            return gram_p
-        zmask = jnp.asarray(zero)
-        return jnp.where(zmask[:, None] | zmask[None, :], 0.0, gram_p)
+        """See ``_sanitize_gram`` — closure over this plan's scales."""
+        return _sanitize_gram(gram_p, plan.row_scale)
 
     if gar.gram_select is not None or gar.tree_aggregate_ext is not None:
         ext = stacked_tree
@@ -238,3 +266,103 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
         return selected, lambda vec: unflatten_vec(vec, treedef, shapes)
 
     return gar.fold_aggregate(gram_p, apply_rows, f=f, key=key, **params)
+
+
+def folded_tree_aggregate_multi(gar, plan, stacked_tree, *, f, keys=None,
+                                gar_params=None, subset_sels=None):
+    """Per-OBSERVER folded aggregation: m wait-n-f views of ONE exchange.
+
+    The decentralized topologies (LEARN phases 2/3/5, ByzSGD's model
+    plane) have every local observer slot aggregate its OWN seeded
+    q-subset of the same gathered stack. For ``gram_select`` rules that
+    is m sub-Gram selections of a SINGLE extension + Gram build — the
+    expensive (n, d)-shaped work (fake-row moments, per-leaf Gram
+    matmuls) is paid once, and each observer adds only a (q, q) gather
+    of the tiny Gram plus one weight row. The weighted sums batch into
+    one (m, rows) matmul per leaf.
+
+    Args:
+      plan: ``GradientAttackFold`` for a deterministic attack, or None for
+        the identity fold (no attack, or a randomized attack already
+        applied to the tree via the where-path).
+      keys: optional (m,) stacked PRNG keys, one per observer (the
+        Gram-form rules draw no randomness, but the key rides through for
+        signature parity with the flat path).
+      subset_sels: (m, q) per-observer row indices, or None for full
+        participation (every observer sees all n rows — m identical
+        selections, still one Gram).
+
+    Returns the aggregated tree with a leading m axis. Rows non-finite in
+    the raw stack are handled exactly as ``apply_rows``: a row selected
+    by NO observer is masked out of the contraction; the Gram-form rules'
+    +inf-distance guard keeps non-finite rows out of every selection, so
+    this matches the per-observer where-path.
+    """
+    if gar.gram_select is None:
+        raise ValueError(
+            "folded_tree_aggregate_multi needs a gram_select rule (the "
+            "per-observer sub-Gram composition; other fold forms need row "
+            "values per observer — topologies route those to the flat path)"
+        )
+    leaves, treedef = jax.tree.flatten(stacked_tree)
+    n = leaves[0].shape[0]
+    params = dict(gar_params or {})
+    params.pop("center", None)  # gram_select rules are stateless
+    if plan is None:
+        rmap = np.arange(n)
+        scale_np = np.ones(n, np.float32)
+        build_extra, num_extra = None, 0
+    else:
+        rmap, scale_np = plan.row_map, plan.row_scale
+        build_extra, num_extra = plan.build_extra, plan.num_extra
+    ext = stacked_tree
+    if build_extra is not None:
+        extra = build_extra(stacked_tree)
+        ext = jax.tree.map(
+            lambda l, e: jnp.concatenate([l, e[None]], axis=0),
+            stacked_tree, extra,
+        )
+    scale = jnp.asarray(scale_np)
+    gram = tree_gram(ext)  # (n+k, n+k), ONE build for all observers
+    gram_p = _sanitize_gram(
+        gram[rmap][:, rmap] * (scale[:, None] * scale[None, :]), scale_np
+    )
+
+    def select_one(sel, key):
+        if sel is None:
+            w = gar.gram_select(gram_p, f=f, key=key, **params)
+        else:
+            w_sub = gar.gram_select(
+                gram_p[sel][:, sel], f=f, key=key, **params
+            )
+            w = jnp.zeros((n,), jnp.float32).at[sel].set(w_sub)
+        return w
+
+    if subset_sels is None:
+        if keys is None:
+            W = select_one(None, None)[None]
+        else:
+            W = jax.vmap(lambda k: select_one(None, k))(keys)
+    elif keys is None:
+        W = jax.vmap(lambda s: select_one(s, None))(subset_sels)
+    else:
+        W = jax.vmap(select_one)(subset_sels, keys)
+    m = W.shape[0]
+    W = W.astype(jnp.float32) * scale[None, :]
+    W_ext = jnp.zeros((m, n + num_extra), jnp.float32).at[:, rmap].add(W)
+    used = jnp.any(W_ext != 0, axis=0)
+
+    def one_leaf(leaf):
+        rows = leaf.shape[0]
+        flat = leaf.reshape(rows, -1)
+        out = jnp.matmul(
+            W_ext.astype(leaf.dtype), jnp.where(used[:, None], flat, 0)
+        )
+        return out.reshape((m,) + leaf.shape[1:])
+
+    out_tree = jax.tree.map(one_leaf, ext)
+    if subset_sels is None and keys is None:
+        # Full participation, no per-observer keys: ONE selection — return
+        # it without the leading axis (the caller broadcasts).
+        return jax.tree.map(lambda l: l[0], out_tree)
+    return out_tree

@@ -34,6 +34,37 @@ re-aggregations of one vector).
 The ceil(log2 t) round count is data-dependent on the step counter, so the
 gossip loop is a ``lax.fori_loop`` over a static ``max_rounds`` with rounds
 beyond the target masked to no-ops (XLA needs static trip structure).
+
+Fast-path parity with aggregathor (the dispatch matrix that topology got in
+r4-r5, ported here): both gradient exchanges (phase 2 and every agreement
+round) AND the model gossip dispatch through the tree/fold stack when
+eligible —
+
+  - deterministic attacks (lie/empire/reverse/crash; byzServer's
+    reverse/crash on the model plane) fold into a Gram remap
+    (``fold.plan_for`` / ``fold.plan_for_model``): the poisoned rows are
+    never written and the raw per-leaf Grams fuse like the fault-free step;
+  - randomized attacks (random/drop) poison the stacked TREE via the
+    where-path (``apply_gradient_attack_tree``) and the GAR still runs in
+    tree mode — the (n, d) flat stack is never built;
+  - per-node wait-n-f subsets COMPOSE with the fold for Gram-form rules:
+    one extension + Gram build serves every local node slot, each adding
+    only a (q, q) sub-Gram selection (``fold.folded_tree_aggregate_multi``
+    — the multi-observer form of aggregathor's subset fast path); non-Gram
+    rules under true subsets keep the flat path (the same
+    ``_tree_path_ok`` gate as aggregathor/byzsgd);
+  - stateful-center rules (cclip) carry a PER-NODE center in
+    ``TrainState.gar_state``: v_0 of phase 2 is the node's previous final
+    aggregate (robust coordinate-median init at step 0 only, under a
+    ``lax.cond`` so the median pass executes exactly once per run), each
+    agreement round re-centers on the node's current aggregate, and the
+    model gossip centers on the node's OWN model — the ClippedGossip
+    recipe (Karimireddy et al. 2021) — so the per-step median init
+    (~5.3 ms at ResNet-18 scale, PERF.md r5) disappears from the
+    decentralized defense config.
+
+``tree_path=False`` forces the flat reference-shaped path everywhere (the
+A/B lever the trajectory-equivalence tests drive).
 """
 
 import functools
@@ -44,9 +75,13 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..attacks import apply_gradient_attack, apply_model_attack
-from . import core, mesh as mesh_lib
-from .aggregathor import _check_gar, _resolve_gar
+from ..attacks import (
+    apply_gradient_attack,
+    apply_gradient_attack_tree,
+    apply_model_attack_rows,
+)
+from . import core, fold, mesh as mesh_lib
+from .aggregathor import _check_gar, _resolve_gar, _tree_path_ok
 
 __all__ = ["make_trainer"]
 
@@ -74,6 +109,8 @@ def make_trainer(
     gar_dtype=None,
     worker_momentum=None,
     gar_params=None,
+    tree_path=True,
+    num_iter=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the LEARN topology.
 
@@ -100,6 +137,13 @@ def make_trainer(
     momentum stack lives in ``TrainState.worker_mom``, sharded over the
     nodes axis with the rest of the node state. Pair with a plain-SGD
     optimizer (see aggregathor.make_trainer — the EMA is the momentum).
+    ``tree_path`` (default on) routes every exchange through the tree/fold
+    fast path where eligible (see module docstring); False forces the flat
+    (n, d) path everywhere (A/B tests).
+    ``num_iter`` is the run-length hint for the unroll-vs-vmap per-slot
+    gradient decision (``core.slot_path_decision``; the slot-FUSED twin is
+    structurally inapplicable here — per-node params mean there is no
+    single shared kernel for the fused forward to use).
     ``step_fn(state, x, y)``: leading ``num_nodes`` axis on x/y and on every
     params/opt_state leaf, all sharded over ``axis``.
     """
@@ -107,6 +151,13 @@ def make_trainer(
     attack_params = dict(attack_params or {})
     gar_params = dict(gar_params or {})
     model_attack_params = dict(model_attack_params or {})
+    if gar.stateful_center and "center" in gar_params:
+        raise ValueError(
+            f"{gar.name!r} carries its center across steps "
+            "(TrainState.gar_state); a fixed gar_params 'center' would "
+            "silently fight the carried state — remove it (standalone "
+            "gars[...](stack, center=...) calls still accept one)"
+        )
     if mesh is None:
         mesh = mesh_lib.make_mesh({axis: -1})
     per_n = mesh_lib.fold(num_nodes, mesh.shape[axis], "nodes")
@@ -123,9 +174,40 @@ def make_trainer(
         byz_mask = core.default_byz_mask(
             num_nodes, f if (attack or model_attack) else 0
         )
+    # Folded plans (static): the gradient plan serves phase 2 AND every
+    # agreement round; the model plan serves the gossip. None -> where-path.
+    fold_plan = fold.plan_for(gar, attack, byz_mask, attack_params)
+    model_fold_plan = fold.plan_for_model(
+        gar, model_attack, byz_mask, model_attack_params
+    )
     byz_mask = jnp.asarray(byz_mask, bool)
 
+    waiting = subset is not None and subset < num_nodes
+    # Gradient-exchange eligibility: the aggregathor/byzsgd gate, with the
+    # sub-Gram subset composition enabled (multi-observer form).
+    grad_tree_ok = _tree_path_ok(
+        tree_path, subset, num_nodes, "model", gar, subset_gram_ok=True
+    )
+    # Model-gossip eligibility: randomized MODEL attacks have no tree
+    # where-path (their draws are defined on the flat model vector), so the
+    # tree route additionally needs the attack to fold (or be absent).
+    gossip_tree_ok = grad_tree_ok and (
+        model_attack in (None, "none") or model_fold_plan is not None
+    )
+
     init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
+    # Per-slot gradient formulation (VERDICT r5 #3): the slot-fused twin is
+    # inapplicable (per-node params — slot_conv's fused primal uses ONE
+    # shared kernel), but the run-length-aware unroll-vs-vmap choice from
+    # core.slot_path_decision applies unchanged.
+    slot_path, slot_why = core.slot_path_decision(
+        per_n, num_iter, fused_available=False
+    )
+    if per_n > 1:
+        from ..utils import tools
+
+        tools.info(f"[learn] per-slot gradients: {slot_path} ({slot_why})")
+    unroll_grads = slot_path == "unroll"
     node_sharding = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
 
@@ -141,6 +223,18 @@ def make_trainer(
                 core.worker_mom_init(params, num_nodes, gar_dtype),
                 node_sharding,
             )
+        gar_state = None
+        if gar.stateful_center:
+            # Per-NODE carried center (v_0 = that node's previous final
+            # aggregate, f32). The zeros here are never consumed: step 0
+            # takes the robust-median-init branch of the lax.cond below.
+            gar_state = jax.device_put(
+                jax.tree.map(
+                    lambda p: jnp.zeros((num_nodes,) + p.shape, jnp.float32),
+                    params,
+                ),
+                node_sharding,
+            )
         return core.TrainState(
             step=jax.device_put(jnp.zeros((), jnp.int32), repl),
             params=jax.device_put(stack(params), node_sharding),
@@ -148,9 +242,8 @@ def make_trainer(
             opt_state=jax.device_put(stack(opt_state), node_sharding),
             rng=jax.device_put(key if seed_rng is None else seed_rng, repl),
             worker_mom=worker_mom,
+            gar_state=gar_state,
         )
-
-    waiting = subset is not None and subset < num_nodes
 
     def _local_step(state, x_local, y_local):
         base = jax.random.fold_in(state.rng, state.step)
@@ -159,7 +252,19 @@ def make_trainer(
         shard = jax.lax.axis_index(axis)
         node_ids = shard * per_n + jnp.arange(per_n)
 
-        def node_aggregate(stack, key, nid):
+        def node_subset_keys(key):
+            """Per-node (sel, gar_key) for one exchange — the SAME key
+            derivation as the flat path's ``node_aggregate`` (keyed by the
+            global node id), so tree and flat trajectories sample identical
+            wait-n-f subsets."""
+
+            def one(nid):
+                sel_key, gkey = jax.random.split(jax.random.fold_in(key, nid))
+                return core.subset_indices(sel_key, num_nodes, subset), gkey
+
+            return jax.vmap(one)(node_ids)
+
+        def node_aggregate(stack, key, nid, center=None):
             """One node's view of an exchange: its own seeded arrival subset
             (the q fastest peers), then the GAR. Keyed by the global node id
             so every shard agrees on what node ``nid`` sampled."""
@@ -167,48 +272,115 @@ def make_trainer(
             if waiting:
                 sel = core.subset_indices(sel_key, stack.shape[0], subset)
                 stack = stack[sel]
-            return gar.unchecked(stack, f=f, key=gkey, **gar_params)
+            extra = {} if center is None else {"center": center}
+            return gar.unchecked(stack, f=f, key=gkey, **gar_params, **extra)
 
-        def local_aggregates(stack, key):
+        def local_aggregates(stack, key, centers=None):
             """All of this shard's node slots aggregate the same gathered
-            stack through their own subsets -> (per_n, d). vmapped over the
-            node ids (one subset+GAR graph regardless of per_n, the same
-            shape as byzsgd's vmapped per-PS slot step)."""
+            (n, d) stack through their own subsets -> (per_n, d). vmapped
+            over the node ids (one subset+GAR graph regardless of per_n,
+            the same shape as byzsgd's vmapped per-PS slot step).
+            ``centers``: optional (per_n, d) per-node carried centers
+            (stateful rules)."""
             if waiting:
+                if centers is None:
+                    return jax.vmap(
+                        lambda nid: node_aggregate(stack, key, nid)
+                    )(node_ids)
                 return jax.vmap(
-                    lambda nid: node_aggregate(stack, key, nid)
-                )(node_ids)
-            # Full participation: one aggregate, identical for every node.
-            one = gar.unchecked(stack, f=f, key=key, **gar_params)
+                    lambda nid, c: node_aggregate(stack, key, nid, c)
+                )(node_ids, centers)
+            # Full participation: one aggregate, identical for every node
+            # (and identical carried centers, so slot 0's suffices).
+            extra = {} if centers is None else {"center": centers[0]}
+            one = gar.unchecked(stack, f=f, key=key, **gar_params, **extra)
             return jnp.broadcast_to(one[None], (per_n,) + one.shape)
 
-        def honest_spread(aggr_local):
+        def tree_exchange(stacked_tree, plan, akey, key, attack_name,
+                          attack_kw, center_tree=None):
+            """One exchange on the stacked TREE: folded deterministic
+            attacks poison the Gram (never the rows); randomized attacks
+            take the tree where-path first; per-node subsets compose onto
+            the sub-Gram. Returns the per-node aggregates as a tree with a
+            leading per_n axis. ``center_tree``: per-node carried centers
+            (leading per_n axis) for stateful rules — consumed on the
+            full-participation route only (the subset route is Gram-form,
+            stateless)."""
+            if plan is None and attack_name not in (None, "none"):
+                stacked_tree = apply_gradient_attack_tree(
+                    attack_name, stacked_tree, byz_mask, key=akey,
+                    **attack_kw,
+                )
+            if waiting:
+                sels, gkeys = node_subset_keys(key)
+                return fold.folded_tree_aggregate_multi(
+                    gar, plan, stacked_tree, f=f, keys=gkeys,
+                    gar_params=gar_params, subset_sels=sels,
+                )
+            center_kw = {}
+            if center_tree is not None:
+                # Full participation: every node's carried center is equal
+                # (identical aggregates every step) — use slot 0's.
+                center_kw = {
+                    "center": jax.tree.map(lambda l: l[0], center_tree)
+                }
+            if plan is not None:
+                one = fold.folded_tree_aggregate(
+                    gar, plan, stacked_tree, f=f, key=key,
+                    gar_params={**gar_params, **center_kw},
+                )
+            else:
+                one = gar.tree_aggregate(
+                    stacked_tree, f=f, key=key, **gar_params, **center_kw
+                )
+            return jax.tree.map(
+                lambda l: jnp.broadcast_to(l[None], (per_n,) + l.shape), one
+            )
+
+        def honest_spread(aggr_rows):
             """Max pairwise L-inf distance between honest nodes' aggregates:
             the disagreement the agreement rounds must shrink."""
-            rows = jax.lax.all_gather(aggr_local, axis, tiled=True)  # (n, d)
+            rows = jax.lax.all_gather(aggr_rows, axis, tiled=True)  # (n, d)
             byz = byz_mask[:, None]
             hi = jnp.max(jnp.where(byz, -jnp.inf, rows), axis=0)
             lo = jnp.min(jnp.where(byz, jnp.inf, rows), axis=0)
             return jnp.max(hi - lo)
 
-        # Phase 1: per-node gradient on its own model + batch (unrolled over
-        # the static local slots; vmapping params over nodes trips conv
-        # batching rules). Keep the stacked TREE through the gather and
-        # flatten once afterwards — raveling each slot inside the unroll
-        # serializes the per-slot concats against fwd+bwd (measured 12%
-        # slower in aggregathor; core.per_slot_grads docstring).
-        grads, losses, ms_list = [], [], []
-        for k in range(per_n):
-            p_k = jax.tree.map(lambda l: l[k], state.params)
-            rng_k = jax.random.fold_in(drop_base, node_ids[k])
-            g, (loss, ms_out) = grad_fn(
-                p_k, state.model_state, x_local[k], y_local[k], rng_k
-            )
-            grads.append(g)
-            losses.append(loss)
-            ms_list.append(ms_out)
-        grads_local = jax.tree.map(lambda *ls: jnp.stack(ls), *grads)
-        losses = jnp.stack(losses)
+        def aggr_rows_of(aggr):
+            """(per_n, d) flat rows of the per-node aggregates, whichever
+            representation the dispatch produced (spread metric only)."""
+            return core.flatten_rows(aggr) if grad_tree_ok else aggr
+
+        # Phase 1: per-node gradient on its own model + batch. Unrolled over
+        # the static local slots below the slot_path_decision cap (vmapping
+        # params over nodes trips conv batching rules at small n; keep the
+        # stacked TREE through the gather and flatten once afterwards —
+        # raveling each slot inside the unroll serializes the per-slot
+        # concats against fwd+bwd, measured 12% slower in aggregathor;
+        # core.per_slot_grads docstring). Above the cap (or when the run
+        # length cannot amortize the unroll's compile premium) the per-node
+        # gradients vmap with params mapped over the node axis.
+        if unroll_grads:
+            grads, losses_list, ms_list = [], [], []
+            for k in range(per_n):
+                p_k = jax.tree.map(lambda l: l[k], state.params)
+                rng_k = jax.random.fold_in(drop_base, node_ids[k])
+                g, (loss, ms_out) = grad_fn(
+                    p_k, state.model_state, x_local[k], y_local[k], rng_k
+                )
+                grads.append(g)
+                losses_list.append(loss)
+                ms_list.append(ms_out)
+            grads_local = jax.tree.map(lambda *ls: jnp.stack(ls), *grads)
+            losses = jnp.stack(losses_list)
+            ms_stack = jax.tree.map(lambda *ls: jnp.stack(ls), *ms_list)
+        else:
+            rngs = jax.vmap(
+                lambda i: jax.random.fold_in(drop_base, i)
+            )(node_ids)
+            grads_local, (losses, ms_stack) = jax.vmap(
+                grad_fn, in_axes=(0, None, 0, 0, 0)
+            )(state.params, state.model_state, x_local, y_local, rngs)
         grads_local = core.cast_leaves(grads_local, gar_dtype)
 
         # Per-node momentum (see make_trainer docstring): each node
@@ -220,57 +392,113 @@ def make_trainer(
                 worker_momentum, state.worker_mom, grads_local
             )
             new_mom = grads_local
-        new_ms = core.mean_model_state(
-            jax.tree.map(lambda *ls: jnp.stack(ls), *ms_list), axis
-        )
+        new_ms = core.mean_model_state(ms_stack, axis)
 
         # Phase 2: gather + attack + aggregate (= get_gradients(i, n-f) of
-        # the fastest peers, LEARN/trainer.py:249; per-node subsets).
+        # the fastest peers, LEARN/trainer.py:249; per-node subsets). The
+        # carried center (stateful rules) is each node's previous final
+        # aggregate; at step 0 the lax.cond takes the robust-median-init
+        # branch instead — the ONLY coordinate-median pass in the whole
+        # step program, executed exactly once per run.
         gathered = jax.tree.map(
             lambda l: jax.lax.all_gather(l, axis, tiled=True), grads_local
         )
-        stack0 = core.flatten_rows(gathered)  # (n, d)
-        stack0 = apply_gradient_attack(
-            attack, stack0, byz_mask, key=atk_key, **attack_params
-        )
-        aggr_local = local_aggregates(stack0, sub_key)  # (per_n, d)
+
+        def phase2(centers_tree, centers_rows):
+            if grad_tree_ok:
+                return tree_exchange(
+                    gathered, fold_plan, atk_key, sub_key, attack,
+                    attack_params, center_tree=centers_tree,
+                )
+            stack0 = core.flatten_rows(gathered)  # (n, d)
+            stack0 = apply_gradient_attack(
+                attack, stack0, byz_mask, key=atk_key, **attack_params
+            )
+            return local_aggregates(stack0, sub_key, centers=centers_rows)
+
+        if gar.stateful_center:
+            carried = state.gar_state  # (per_n, ...) local shard
+            carried_rows = (
+                None if grad_tree_ok else core.flatten_rows(carried)
+            )
+            aggr_local = jax.lax.cond(
+                state.step == 0,
+                lambda: phase2(None, None),
+                lambda: phase2(
+                    carried if grad_tree_ok else None, carried_rows
+                ),
+            )
+        else:
+            aggr_local = phase2(None, None)
 
         metrics_extra = {}
         if track_spread:
-            metrics_extra["aggr_spread_pre"] = honest_spread(aggr_local)
+            metrics_extra["aggr_spread_pre"] = honest_spread(
+                aggr_rows_of(aggr_local)
+            )
 
         # Phase 3: avg_agree rounds (ceil(log2 t), LEARN/trainer.py:208-222).
         # Each round every node PUBLISHES its own current aggregate (they
         # differ under wait-n-f), Byzantine rows are poisoned, and each node
         # re-aggregates its own num_wait_ps = q subset of the gathered stack
-        # (get_aggr_grads polling, server.py:202-233).
+        # (get_aggr_grads polling, server.py:202-233). Stateful rules
+        # re-center each round on the node's CURRENT aggregate (the natural
+        # v_0: the previous round's output).
         if non_iid:
             t = jnp.maximum(state.step, 1).astype(jnp.float32)
             rounds = jnp.ceil(jnp.log2(jnp.maximum(t, 2.0))).astype(jnp.int32)
             rounds = jnp.minimum(rounds, max_rounds)
 
-            def round_body(r, aggr_local):
-                served = jax.lax.all_gather(
-                    aggr_local, axis, tiled=True
-                )  # (n, d): every node's own aggregate, not n copies of one
-                akey, skey = jax.random.split(jax.random.fold_in(gossip_key, r))
-                served = apply_gradient_attack(
-                    attack, served, byz_mask, key=akey, **attack_params
-                )
-                new = local_aggregates(served, skey)
-                return jnp.where(r < rounds, new, aggr_local)
+            if grad_tree_ok:
+                def round_body(r, aggr):
+                    served = jax.tree.map(
+                        lambda l: jax.lax.all_gather(l, axis, tiled=True),
+                        aggr,
+                    )  # (n, ...) leaves: every node's own aggregate
+                    akey, skey = jax.random.split(
+                        jax.random.fold_in(gossip_key, r)
+                    )
+                    new = tree_exchange(
+                        served, fold_plan, akey, skey, attack, attack_params,
+                        center_tree=aggr if gar.stateful_center else None,
+                    )
+                    return jax.tree.map(
+                        lambda a, b: jnp.where(r < rounds, a, b), new, aggr
+                    )
+            else:
+                def round_body(r, aggr):
+                    served = jax.lax.all_gather(aggr, axis, tiled=True)
+                    akey, skey = jax.random.split(
+                        jax.random.fold_in(gossip_key, r)
+                    )
+                    served = apply_gradient_attack(
+                        attack, served, byz_mask, key=akey, **attack_params
+                    )
+                    new = local_aggregates(
+                        served, skey,
+                        centers=aggr if gar.stateful_center else None,
+                    )
+                    return jnp.where(r < rounds, new, aggr)
 
-            aggr_local = jax.lax.fori_loop(0, max_rounds, round_body, aggr_local)
+            aggr_local = jax.lax.fori_loop(
+                0, max_rounds, round_body, aggr_local
+            )
 
         if track_spread:
-            metrics_extra["aggr_spread_post"] = honest_spread(aggr_local)
+            metrics_extra["aggr_spread_post"] = honest_spread(
+                aggr_rows_of(aggr_local)
+            )
 
         # Phase 4: per-node optimizer step on that node's own aggregate.
-        new_params_list, new_opt_list = [], []
+        new_params_list, new_opt_list, aggr_trees = [], [], []
         for k in range(per_n):
             p_k = jax.tree.map(lambda l: l[k], state.params)
             o_k = jax.tree.map(lambda l: l[k], state.opt_state)
-            aggr_tree = core.unflatten_like(p_k, aggr_local[k])
+            if grad_tree_ok:
+                aggr_tree = jax.tree.map(lambda l: l[k], aggr_local)
+            else:
+                aggr_tree = core.unflatten_like(p_k, aggr_local[k])
+            aggr_trees.append(aggr_tree)
             aggr_tree = core.cast_like(aggr_tree, p_k)  # no-op at f32
             updates, o_k = optimizer.update(aggr_tree, o_k, p_k)
             new_params_list.append(optax.apply_updates(p_k, updates))
@@ -278,27 +506,50 @@ def make_trainer(
         new_params = jax.tree.map(lambda *ls: jnp.stack(ls), *new_params_list)
         new_opt = jax.tree.map(lambda *ls: jnp.stack(ls), *new_opt_list)
 
+        new_gar_state = state.gar_state
+        if gar.stateful_center:
+            # Next step's per-node v_0 = this step's final aggregate (f32 —
+            # the carried center should not round through the bf16 pipeline).
+            new_gar_state = jax.tree.map(
+                lambda *ls: jnp.stack([l.astype(jnp.float32) for l in ls]),
+                *aggr_trees,
+            )
+
         # Phase 5: model gossip (LEARN/trainer.py:255-257, get_models(n-f) —
         # each node GAR-aggregates its own subset of the gossiped models).
+        # Deterministic model attacks (reverse/crash) fold like the
+        # gradient plane; stateful rules center each node's clip on its OWN
+        # model (the ClippedGossip recipe) instead of a per-call median.
         if model_gossip:
-            flat_models = core.flatten_rows(new_params)  # (per_n, d)
-            models = jax.lax.all_gather(flat_models, axis, tiled=True)
-            poisoned = jax.vmap(
-                lambda i, m: apply_model_attack(
-                    model_attack, m, key=jax.random.fold_in(matk_key, i),
+            if gossip_tree_ok:
+                models_tree = jax.tree.map(
+                    lambda l: jax.lax.all_gather(l, axis, tiled=True),
+                    new_params,
+                )
+                new_params = tree_exchange(
+                    models_tree, model_fold_plan, matk_key, msub_key,
+                    None, {},
+                    center_tree=new_params if gar.stateful_center else None,
+                )
+            else:
+                flat_models = core.flatten_rows(new_params)  # (per_n, d)
+                models = jax.lax.all_gather(flat_models, axis, tiled=True)
+                models = apply_model_attack_rows(
+                    model_attack, models, byz_mask, key=matk_key,
                     **model_attack_params,
                 )
-            )(jnp.arange(num_nodes), models)
-            models = jnp.where(byz_mask[:, None], poisoned, models)
-            aggr_models = local_aggregates(models, msub_key)  # (per_n, d)
-            template = jax.tree.map(lambda l: l[0], new_params)
-            new_params = jax.tree.map(
-                lambda *ls: jnp.stack(ls),
-                *[
-                    core.unflatten_like(template, aggr_models[k])
-                    for k in range(per_n)
-                ],
-            )
+                aggr_models = local_aggregates(
+                    models, msub_key,
+                    centers=flat_models if gar.stateful_center else None,
+                )  # (per_n, d)
+                template = jax.tree.map(lambda l: l[0], new_params)
+                new_params = jax.tree.map(
+                    lambda *ls: jnp.stack(ls),
+                    *[
+                        core.unflatten_like(template, aggr_models[k])
+                        for k in range(per_n)
+                    ],
+                )
 
         honest = (~byz_mask).astype(losses.dtype)[node_ids]
         loss_num = jax.lax.psum(jnp.sum(losses * honest), axis)
@@ -318,6 +569,7 @@ def make_trainer(
                 model_state=new_ms,
                 opt_state=new_opt,
                 worker_mom=new_mom,
+                gar_state=new_gar_state,
             ),
             {"loss": mean_loss, **metrics_extra},
         )
@@ -325,8 +577,9 @@ def make_trainer(
     state_specs = core.TrainState(
         step=P(), params=P(axis), model_state=P(), opt_state=P(axis), rng=P(),
         worker_mom=(P(axis) if worker_momentum is not None else None),
+        gar_state=(P(axis) if gar.stateful_center else None),
     )
-    sharded_step = jax.shard_map(
+    sharded_step = mesh_lib.shard_map(
         _local_step,
         mesh=mesh,
         in_specs=(state_specs, P(axis), P(axis)),
@@ -334,7 +587,7 @@ def make_trainer(
         check_vma=False,
     )
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(jax.jit, donate_argnums=core.step_donation())
     def step_fn(state, x, y):
         return sharded_step(state, x, y)
 

@@ -7,7 +7,10 @@ whole ``TrainState`` pytree, keyed by step, with ``latest_step`` discovery so
 ``--resume`` continues a killed run bit-exactly (state.rng + fold_in(step)
 makes the step stream replayable — core.py TrainState docstring).
 
-Falls back to a pickle-of-numpy-leaves format if orbax is unavailable.
+Falls back to a pickle-of-numpy-leaves format if orbax is unavailable —
+and uses it by default on the XLA:CPU backend, where orbax's background
+commit threads are unsound (see ``_use_orbax``). ``GARFIELD_CKPT_BACKEND``
+forces either backend.
 """
 
 import os
@@ -25,6 +28,36 @@ try:  # orbax is in the baked image; guard anyway (zero-install rule)
 except Exception:  # pragma: no cover
     _HAVE_ORBAX = False
 
+# GARFIELD_CKPT_BACKEND=pickle|orbax overrides the automatic choice.
+_BACKEND = os.environ.get("GARFIELD_CKPT_BACKEND", "").strip().lower()
+if _BACKEND not in ("", "pickle", "orbax"):  # pragma: no cover
+    raise ValueError(
+        f"GARFIELD_CKPT_BACKEND={_BACKEND!r}: expected 'pickle' or 'orbax'"
+    )
+
+
+def _use_orbax():
+    """Orbax on real device backends; pickle on XLA:CPU (or by env).
+
+    orbax's CheckpointManager keeps background commit threads alive past
+    ``wait_until_finished``, and on this jaxlib's XLA:CPU runtime a
+    native thread touching the runtime while the training thread
+    dispatches donating steps is unsound — the process dies with a
+    native SIGSEGV/SIGABRT, not an exception (same failure class, and
+    same remedy, as the CPU-inline readback guard in
+    ``parallel.compute_accuracy_async``). The window only opens when
+    compiles are warm enough for steps to dispatch back-to-back, which
+    is exactly the cached test/CI configuration. The pickle format is
+    per-backend: a run checkpointed on one backend resumes on the same
+    backend (cross-backend resume was never supported — shardings
+    differ).
+    """
+    if _BACKEND == "pickle":
+        return False
+    if _BACKEND == "orbax":
+        return _HAVE_ORBAX
+    return _HAVE_ORBAX and jax.default_backend() != "cpu"
+
 
 def _np_leaves(tree):
     return jax.tree.map(lambda l: np.asarray(l), tree)
@@ -37,7 +70,7 @@ class Checkpointer:
         self.directory = os.path.abspath(str(directory))
         self.max_to_keep = max_to_keep
         os.makedirs(self.directory, exist_ok=True)
-        if _HAVE_ORBAX:
+        if _use_orbax():
             self._mgr = ocp.CheckpointManager(
                 self.directory,
                 options=ocp.CheckpointManagerOptions(

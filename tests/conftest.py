@@ -35,13 +35,41 @@ import jax
 
 if not _USE_TPU:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # Older jax spells the virtual device count only through XLA_FLAGS
+        # (set above) — same 8-device CPU platform either way.
+        pass
 
 # Persistent compilation cache: CPU test compiles of the large SPMD programs
-# dominate suite time; caching them across runs keeps the suite fast.
+# dominate suite time; caching them across runs keeps the suite fast. The
+# directory is keyed by the jax/jaxlib versions (same scheme as
+# utils.profiling.enable_compile_cache): cached executables are not
+# serialization-stable across jaxlib builds, and a stale entry from a
+# previous container deserializes into a native SIGSEGV, not a catchable
+# cache miss.
+import jaxlib
+
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.path.expanduser("~/.cache/garfield_tpu/jax_cache"),
+    os.path.expanduser(
+        f"~/.cache/garfield_tpu/jax_cache-"
+        f"{jax.__version__}-{jaxlib.__version__}"
+    ),
 )
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+# End-to-end trainer files last. Alphabetical collection puts
+# test_apps.py (ten full CLI training runs, ~1 min each on a 1-core
+# container) FIRST, so a tier-1 wall-clock budget hit starves the entire
+# unit matrix behind it. Run units first and the end-to-end runs last: a
+# timeout then costs the slowest, most redundant coverage (the app flows
+# are also exercised piecewise by the unit files), not the matrix.
+_RUN_LAST = {"test_apps.py": 1}
+
+
+def pytest_collection_modifyitems(config, items):
+    items.sort(key=lambda it: _RUN_LAST.get(it.fspath.basename, 0))

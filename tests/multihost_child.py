@@ -18,7 +18,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:  # older jax: XLA_FLAGS (set by the parent) rules
+    pass
 
 
 def main(config_path):
@@ -58,7 +61,7 @@ def main(config_path):
         return gar.unchecked(stack, f=f)
 
     aggr = jax.jit(
-        jax.shard_map(
+        mesh_lib.shard_map(
             step, mesh=mesh, in_specs=P("workers"), out_specs=P(),
             check_vma=False,
         )

@@ -24,6 +24,10 @@ import time
 import pytest
 
 pytest.importorskip("garfield_tpu.native")
+
+# Multi-process deployments compile per process: minutes per test by design.
+# The tier-1 fast shard (-m "not slow") skips them; CI runs the full suite.
+pytestmark = pytest.mark.slow
 from garfield_tpu import native
 
 if native.load() is None:

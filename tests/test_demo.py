@@ -7,7 +7,13 @@ import json
 import threading
 import time
 
+import pytest
+
 from garfield_tpu.apps import demo
+
+# Spins a live training thread + HTTP server: minutes per test by design
+# (tier-1 fast shard skips via -m 'not slow').
+pytestmark = pytest.mark.slow
 
 
 def _request(port, method, path, body=None):

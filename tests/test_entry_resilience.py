@@ -1,0 +1,70 @@
+"""Driver-entry-point resilience to a dead/hung default backend.
+
+The r5 outage (VERDICT "Next round" #1a): with the TPU tunnel down,
+in-process ``jax.devices()`` blocked forever inside plugin init — bench.py
+died rc=1 with an unparseable traceback and dryrun_multichip hung to the
+driver's rc=124 timeout. The entry points now (a) probe the device count in
+a short-timeout SUBPROCESS before any in-process backend use
+(``profiling.probe_device_count``), (b) fall back to the virtual CPU mesh
+(or honor ``GARFIELD_FORCE_CPU_DRYRUN``), and (c) emit one parseable
+``{"error": ...}`` JSON line on any bench failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from garfield_tpu.utils import profiling
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestProbeDeviceCount:
+    def test_probe_counts_cpu_devices(self):
+        # conftest exports JAX_PLATFORMS=cpu + the 8-device XLA flag to
+        # subprocesses, so the probe sees the same virtual platform.
+        n = profiling.probe_device_count()
+        assert n is not None and n >= 1
+
+    def test_probe_timeout_returns_none(self):
+        # A timeout must bound a hung plugin init: the probe gives up and
+        # returns None instead of blocking the caller.
+        assert profiling.probe_device_count(timeout_s=0.001) is None
+
+    def test_probe_failure_returns_none(self, monkeypatch):
+        # A broken interpreter path (stand-in for any probe crash) is a
+        # clean None, never an exception.
+        monkeypatch.setattr(
+            sys, "executable", "/nonexistent/python-definitely-missing"
+        )
+        assert profiling.probe_device_count(timeout_s=5) is None
+
+
+@pytest.mark.slow
+class TestBenchErrorContract:
+    def test_bench_failure_emits_parseable_error_json(self):
+        """Any bench failure must surface as ONE parseable {"error": ...}
+        line on stdout (rc 0), never a bare traceback — the r5 BENCH
+        artifact was rc=1 with parsed: null."""
+        env = dict(os.environ)
+        env["GARFIELD_FORCE_CPU_DRYRUN"] = "1"  # skip the probe (fast path)
+        env["GARFIELD_BENCH_GAR"] = "no-such-rule"
+        env["GARFIELD_BENCH_STEPS"] = "1"
+        env["GARFIELD_BENCH_TRIALS"] = "1"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO_ROOT, "bench.py")],
+            cwd=_REPO_ROOT,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [l for l in proc.stdout.splitlines() if l.strip()]
+        assert lines, "bench printed nothing to stdout"
+        payload = json.loads(lines[-1])
+        assert "error" in payload and payload["error"]

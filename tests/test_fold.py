@@ -18,7 +18,10 @@ from garfield_tpu.attacks import (
     plan_gradient_attack_fold,
 )
 from garfield_tpu.parallel import core
-from garfield_tpu.parallel.fold import folded_tree_aggregate
+from garfield_tpu.parallel.fold import (
+    folded_tree_aggregate,
+    folded_tree_aggregate_multi,
+)
 
 N, F = 8, 2
 
@@ -233,6 +236,144 @@ class TestFoldedAggregate:
             np.asarray(w @ g), np.asarray(gars["krum"].unchecked(g, f=F)),
             rtol=1e-5, atol=1e-6,
         )
+
+
+class TestFoldedAggregateMulti:
+    """Per-observer sub-Gram composition (fold.folded_tree_aggregate_multi):
+    ONE extension+Gram build, m wait-n-f selections — must equal each
+    observer's own poison-subset-aggregate where-path."""
+
+    @pytest.mark.parametrize("gar_name", ["krum", "average", "brute"])
+    @pytest.mark.parametrize("attack", ["lie", "reverse", "crash", None])
+    def test_matches_per_observer_where_path(self, gar_name, attack):
+        gar = gars[gar_name]
+        mask = core.default_byz_mask(N, F)
+        tree = _stacked_tree(jax.random.PRNGKey(29))
+        q, m = N - 1, 4
+        sels = jnp.stack([
+            core.subset_indices(jax.random.PRNGKey(100 + i), N, q)
+            for i in range(m)
+        ])
+        keys = jax.random.split(jax.random.PRNGKey(31), m)
+        plan = (
+            plan_gradient_attack_fold(attack, mask)
+            if attack is not None else None
+        )
+        poisoned = tree
+        if attack is not None and plan is None:
+            pytest.skip("attack folds; nothing to test via identity plan")
+        if attack is not None:
+            poisoned = apply_gradient_attack_tree(
+                attack, tree, jnp.asarray(mask)
+            )
+        got = folded_tree_aggregate_multi(
+            gar, plan, tree, f=F, keys=keys, subset_sels=sels
+        )
+        for i in range(m):
+            sub = jax.tree.map(lambda l: l[sels[i]], poisoned)
+            want = gar.tree_aggregate(sub, f=F, key=keys[i])
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a[i]), np.asarray(b), rtol=1e-5, atol=1e-6
+                ),
+                got, want,
+            )
+
+    def test_identity_plan_randomized_attack_composes(self):
+        """Randomized attacks take the tree where-path FIRST, then the
+        identity fold — the dispatch the decentralized topologies use."""
+        gar = gars["krum"]
+        mask = core.default_byz_mask(N, F)
+        tree = _stacked_tree(jax.random.PRNGKey(37))
+        poisoned = apply_gradient_attack_tree(
+            "random", tree, jnp.asarray(mask), key=jax.random.PRNGKey(5)
+        )
+        q, m = N - 1, 3
+        sels = jnp.stack([
+            core.subset_indices(jax.random.PRNGKey(200 + i), N, q)
+            for i in range(m)
+        ])
+        got = folded_tree_aggregate_multi(
+            gar, None, poisoned, f=F, subset_sels=sels
+        )
+        for i in range(m):
+            sub = jax.tree.map(lambda l: l[sels[i]], poisoned)
+            want = gar.tree_aggregate(sub, f=F)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a[i]), np.asarray(b), rtol=1e-5, atol=1e-6
+                ),
+                got, want,
+            )
+
+    def test_non_gram_rule_rejected(self):
+        with pytest.raises(ValueError, match="gram_select"):
+            folded_tree_aggregate_multi(
+                gars["median"], None, _stacked_tree(jax.random.PRNGKey(2)),
+                f=F, subset_sels=jnp.stack([jnp.arange(N - 1)] * 2),
+            )
+
+
+class TestBf16FoldParity:
+    """bf16 fold-parity rows (ADVICE r5 #3/#5): under the narrow pipeline
+    the folded selection must match the where-path. aksel now quantizes its
+    deviation to the stack dtype before squaring (same sort keys bitwise),
+    so its aggregates agree to weighted-sum rounding; cclip's residual
+    reduction-order drift is documented in its fold docstring, and this row
+    pins the agreed tolerance."""
+
+    def _bf16_tree(self, key):
+        return jax.tree.map(
+            lambda l: l.astype(jnp.bfloat16), _stacked_tree(key)
+        )
+
+    @pytest.mark.parametrize("attack", ["lie", "empire", "reverse", "crash"])
+    def test_aksel_bf16_selection_parity(self, attack):
+        gar = gars["aksel"]
+        mask = core.default_byz_mask(N, F)
+        tree = self._bf16_tree(jax.random.PRNGKey(41))
+        plan = plan_gradient_attack_fold(attack, mask)
+        got = folded_tree_aggregate(gar, plan, tree, f=F)
+        poisoned = apply_gradient_attack_tree(attack, tree, jnp.asarray(mask))
+        want = gar.tree_aggregate(poisoned, f=F)
+        # A selection mismatch swaps O(1)-magnitude rows in a c=4 average
+        # (error ~0.25); bf16 weighted-sum rounding is ~1e-2. The tolerance
+        # separates the two regimes cleanly.
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=5e-2, atol=5e-2,
+            ),
+            got, want,
+        )
+
+    @pytest.mark.parametrize("attack", ["lie", "reverse"])
+    @pytest.mark.parametrize("carried_center", [False, True])
+    def test_cclip_bf16_documented_drift_bound(self, attack, carried_center):
+        gar = gars["cclip"]
+        mask = core.default_byz_mask(N, F)
+        tree = self._bf16_tree(jax.random.PRNGKey(43))
+        center = (
+            jax.tree.map(
+                lambda l: jnp.mean(l.astype(jnp.float32), axis=0), tree
+            ) if carried_center else None
+        )
+        plan = plan_gradient_attack_fold(attack, mask)
+        got = folded_tree_aggregate(
+            gar, plan, tree, f=F,
+            gar_params={"center": center} if center is not None else None,
+        )
+        poisoned = apply_gradient_attack_tree(attack, tree, jnp.asarray(mask))
+        want = gar.tree_aggregate(poisoned, f=F, center=center)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=5e-2, atol=5e-2,
+            ),
+            got, want,
+        )
+        for leaf in jax.tree.leaves(got):
+            assert np.isfinite(np.asarray(leaf, np.float32)).all()
 
 
 @pytest.mark.parametrize("gar_name,f", [

@@ -13,8 +13,13 @@ import socket
 import subprocess
 import sys
 
+import pytest
 
 from garfield_tpu.utils import multihost
+
+# Two full jax processes + DCN bootstrap per test: minutes by design
+# (tier-1 fast shard skips via -m 'not slow').
+pytestmark = pytest.mark.slow
 
 _CHILD = os.path.join(os.path.dirname(__file__), "multihost_child.py")
 
