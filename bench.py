@@ -117,16 +117,48 @@ def _measure(step_fn, init_fn, x, y, steps):
     return dt, compiled
 
 
+def _emit_jsonl(fields):
+    """Append the schema-versioned JSONL twin of the stdout line
+    (garfield_tpu.telemetry.exporters) — the format BENCH_r* artifacts
+    adopt, validated by the tier-1 schema check so a malformed capture
+    fails loudly instead of going dark. Path: GARFIELD_BENCH_JSONL
+    (default ./bench_telemetry.jsonl; empty string disables). Best-effort:
+    the stdout JSON contract stays total either way."""
+    try:
+        from garfield_tpu.telemetry import exporters
+
+        path = os.environ.get("GARFIELD_BENCH_JSONL", "bench_telemetry.jsonl")
+        if path:
+            exporters.append_record(
+                path,
+                exporters.make_record(
+                    "bench",
+                    metric=fields.get("metric", "error"),
+                    value=fields.get("value"),
+                    unit=fields.get("unit"),
+                    vs_baseline=fields.get("vs_baseline"),
+                    mfu=fields.get("mfu"),
+                    error=fields.get("error"),
+                    t=time.time(),
+                ),
+            )
+    except Exception as e:  # noqa: BLE001 — telemetry never fails the bench
+        print(f"bench: JSONL emission failed: {e}", file=sys.stderr)
+
+
 def main():
     """Entry point: run the benchmark, emitting ONE JSON line no matter
     what. A dead backend or any uncaught error becomes a parseable
     ``{"error": ...}`` object instead of a hang or a traceback (VERDICT r5
     #1a: BENCH_r05 died rc=1 with ``parsed: null`` when the TPU tunnel was
-    down at capture time)."""
+    down at capture time). Each line also lands as a schema-versioned
+    JSONL record (``_emit_jsonl``)."""
     try:
         _main_impl()
     except Exception as e:  # noqa: BLE001 — the JSON contract is total
-        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        err = {"error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(err))
+        _emit_jsonl(err)
         sys.exit(0)
 
 
@@ -278,13 +310,15 @@ def _main_impl():
     )
     if not official:
         vs = None
-    print(json.dumps({
+    result = {
         "metric": metric,
         "value": round(steps_per_sec_per_chip, 4),
         "unit": "steps/s/chip",
         "vs_baseline": round(vs, 4) if vs is not None else None,
         "mfu": round(mfu, 4) if mfu is not None else None,
-    }))
+    }
+    print(json.dumps(result))
+    _emit_jsonl(result)
 
 
 if __name__ == "__main__":

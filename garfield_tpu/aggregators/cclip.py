@@ -78,14 +78,16 @@ def aggregate(gradients, f=0, key=None, center=None, tau=None,
     eps = jnp.asarray(1e-12, jnp.float32)
     if center is None:
         # NaN-last lower median (jnp.median would propagate a poisoned
-        # row's NaN into every coordinate of the init). Cast to f32 so
-        # _clip_step's subtraction runs at the SAME width as the folded
-        # path's (which computes radii from f32 deviations) and as the
-        # carried-center production config (TrainState.gar_state is f32)
-        # — under a bf16 pipeline a stack-dtype subtraction here rounded
-        # the tau median differently from the fold on the very first
-        # standalone step (ADVICE r5 #5).
-        center = coordinate_median(stack).astype(jnp.float32)
+        # row's NaN into every coordinate of the init).
+        center = coordinate_median(stack)
+    # The center ALWAYS iterates at f32, however it arrived (median init,
+    # carried TrainState.gar_state — f32 by construction — or a caller-
+    # supplied v_0): _clip_step's subtraction must run at the SAME width
+    # as the folded path's f32 deviations, or under a bf16 pipeline the
+    # two paths round the tau median differently from the very first
+    # step (ADVICE r5 #5; the fold-side twin cast lives in
+    # fold_flat_aggregate).
+    center = jnp.asarray(center).astype(jnp.float32)
     for _ in range(iters):
         center = _clip_step(stack, center, tau, eps)
     return center
@@ -180,7 +182,16 @@ def fold_flat_aggregate(ext_stack, row_map, row_scale, f=0, key=None,
             ext_stack, row_map=rmap, row_scale=scales
         ).astype(jnp.float32)
     bad_log = row_bad[rmap] & (s_log != 0)
-    v = center
+    # Shared-subtraction-dtype contract (ADVICE r5): BOTH paths iterate
+    # the center at f32 regardless of how it arrived — `aggregate` casts
+    # the where-path's center (median init or caller-supplied) and this
+    # cast is its fold twin. Without it a bf16 caller-supplied center
+    # would round through bf16 between iterations here while the
+    # where-path kept f32, drifting the radii and tau per iteration. f32
+    # (not quantize-to-stack-dtype) is the chosen direction because the
+    # production carried center (TrainState.gar_state) is f32 by
+    # construction and must not round through the narrow pipeline.
+    v = jnp.asarray(center).astype(jnp.float32)
     for _ in range(iters):
         vf = v.astype(jnp.float32)
         # ONE fused read of the stack: ||row - v||^2 (and <row, v> only

@@ -108,7 +108,10 @@ def main(argv=None):
     p.add_argument("--f_mode", choices=["max", "one"], default="max",
                    help="f per (rule, n): contract maximum or fixed 1.")
     p.add_argument("--json", type=str, default=None,
-                   help="Also dump results to this JSON file.")
+                   help="Also dump results to this JSON file (plus the "
+                        "schema-versioned telemetry JSONL twin at the same "
+                        "path with a .jsonl suffix — one 'gar_bench' record "
+                        "per cell, validated by the tier-1 schema check).")
     args = p.parse_args(argv)
 
     key = jax.random.PRNGKey(0)
@@ -142,6 +145,22 @@ def main(argv=None):
     if args.json:
         with open(args.json, "w") as fp:
             json.dump(results, fp, indent=1)
+        # Schema-versioned JSONL twin (telemetry/exporters.py): the format
+        # future GARBENCH_r* artifacts adopt — the tier-1 schema check
+        # validates it, so a malformed sweep fails loudly.
+        import os
+
+        from ...telemetry import exporters
+
+        jsonl_path = os.path.splitext(args.json)[0] + ".jsonl"
+        with exporters.JsonlExporter(jsonl_path) as exp:
+            for row in results:
+                exp.write(exporters.make_record(
+                    "gar_bench",
+                    gar=row["gar"], n=row["n"], f=row["f"], d=row["d"],
+                    latency_s=row["latency_s"],
+                    below_noise_floor=row.get("below_noise_floor", False),
+                ))
     return results
 
 

@@ -65,6 +65,7 @@ from jax.flatten_util import ravel_pytree
 
 from ..aggregators import gars
 from ..parallel import core
+from ..telemetry import hub as tele_hooks
 from ..utils import multihost, tools
 from ..utils.exchange import PeerExchange
 from . import common
@@ -163,6 +164,43 @@ def _startup_ms(args):
         args.cluster_timeout_ms,
         int(os.environ.get("GARFIELD_STARTUP_TIMEOUT_MS", 1_800_000)),
     )
+
+
+def _telemetry_open(args, who, num_ranks=None, meta=None):
+    """Per-role telemetry plane for cluster deployments: one MetricsHub
+    streaming into ``<dir>/<who>.telemetry.jsonl`` (each process writes
+    its own file — roles are separate OS processes), installed as the
+    process-global sink so exchange wait latencies and the liveness
+    events below land in the stream. Returns (hub, exporter) or
+    (None, None) when --telemetry is off."""
+    if not getattr(args, "telemetry", None):
+        return None, None
+    import os
+
+    from ..telemetry import exporters as tele_fmt
+
+    os.makedirs(args.telemetry, exist_ok=True)
+    exp = tele_fmt.JsonlExporter(
+        os.path.join(args.telemetry, f"{who}.telemetry.jsonl")
+    )
+    hub = tele_hooks.MetricsHub(
+        num_ranks=num_ranks,
+        meta={"tag": who, "gar": args.gar, "fw": args.fw, **(meta or {})},
+        sink=exp,
+    )
+    exp.write(tele_fmt.make_record("run", meta=hub.meta))
+    tele_hooks.install(hub)
+    return hub, exp
+
+
+def _telemetry_close(hub, exp):
+    if hub is None:
+        return
+    try:
+        exp.write(hub.summary())
+    finally:
+        exp.close()
+        tele_hooks.uninstall()
 
 
 def _robust_stats(rows, f):
@@ -355,6 +393,9 @@ def _gradient_quorum(ex, step, q, good_ranks, expect_bytes, republish,
                 f"[{who}] step {step} quorum timed out; re-publishing "
                 f"the model (attempt {attempts})"
             )
+            tele_hooks.emit_event(
+                "quorum_retry", who=who, step=int(step), attempt=attempts
+            )
             republish()
             continue
         bad = [k for k in got if len(got[k]) != expect_bytes]
@@ -365,6 +406,10 @@ def _gradient_quorum(ex, step, q, good_ranks, expect_bytes, republish,
                 f"[{who}] worker rank {k} sent a malformed "
                 f"{len(got[k])}-byte gradient (expected {expect_bytes}); "
                 "excluding it from all future quorums"
+            )
+            tele_hooks.emit_event(
+                "quorum_exclusion", who=who, step=int(step), rank=int(k),
+                got_bytes=len(got[k]), expect_bytes=int(expect_bytes),
             )
         good_ranks = [k for k in good_ranks if k not in bad]
         if len(good_ranks) < q:
@@ -414,6 +459,28 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
     gar_params = dict(getattr(args, "gar_params", None) or {})
 
     gar_base_key = jax.random.PRNGKey(args.seed)
+
+    # Telemetry plane (docs/TELEMETRY.md): this PS is the deployment's
+    # natural audit point — it sees the REAL arrival order, so
+    # ``observed`` marks the q fastest workers (true wait-n-f, not the
+    # on-mesh seeded emulation) and the tap audits the rule's selection
+    # inside that quorum. Exchange waits and quorum exclusions stream in
+    # through the global hook.
+    n_w = len(worker_ranks)
+    tele_hub, tele_exp = _telemetry_open(
+        args, "cluster-ps", num_ranks=n_w,
+        meta={"attack": getattr(args, "attack", None), "q": q},
+    )
+    tap_fn = None
+    if tele_hub is not None:
+        from ..telemetry import taps as taps_lib
+
+        @jax.jit
+        def tap_fn(stack, sel):
+            bundle = taps_lib.compute_flat(
+                gar.name, stack, f, params=gar_params
+            )
+            return taps_lib.scatter(bundle, sel, n_w)
 
     @jax.jit
     def ps_update(flat_params, opt_state, grads_stack, step):
@@ -481,6 +548,7 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
             start_iter = last_saved = int(step)
             print(f"[cluster-ps] resumed from step {start_iter}", flush=True)
     for i in range(start_iter, args.num_iter):
+        t_step = time.time()
         ex.publish(i, flat.tobytes() + bn_mean.tobytes(), to=worker_ranks)
         got, good_ranks = _gradient_quorum(
             ex, i, q, good_ranks, d_bytes + bn_bytes,
@@ -507,6 +575,16 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
             jnp.asarray(i, jnp.int32),
         )
         flat = np.asarray(flat_dev, np.float32)  # next step's publication
+        if tele_hub is not None:
+            # Worker index = exchange rank - first worker rank; the q
+            # quorum members are the observed ranks this step.
+            sel = jnp.asarray(
+                [k - worker_ranks[0] for k in sorted(got)[:q]], jnp.int32
+            )
+            tele_hub.record_step(
+                i, tap=tap_fn(jnp.asarray(np.stack(rows)), sel),
+                step_time_s=time.time() - t_step,
+            )
         losses_seen = i + 1
         if ckpt and args.checkpoint_freq and (i + 1) % args.checkpoint_freq == 0:
             ckpt.save(i + 1, {
@@ -542,6 +620,7 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
         "steps": losses_seen,
         "wall_s": time.time() - t0,
     }
+    _telemetry_close(tele_hub, tele_exp)
     print(json.dumps({"tag": "cluster-ps", **summary}), flush=True)
     return summary
 
@@ -610,6 +689,11 @@ class _ModelPlane:
             f"{len(self.ranks)} replicas remain, model GAR "
             f"{self.gar_name!r} at fps={self.fps}"
         )
+        tele_hooks.emit_event(
+            "plane_drop", who=self.who, ranks=[int(r) for r in dead],
+            survivors=len(self.ranks), model_gar=self.gar_name,
+            fps=int(self.fps),
+        )
 
     def dropped(self):
         return [r for r in self.all_ranks if r not in self.ranks]
@@ -629,6 +713,11 @@ class _ModelPlane:
             f"[{self.who}] model plane re-admitted rank {rank} (round "
             f"progress observed after a drop); {len(self.ranks)} replicas, "
             f"model GAR {self.gar_name!r} at fps={self.fps}"
+        )
+        tele_hooks.emit_event(
+            "plane_readmit", who=self.who, rank=int(rank),
+            replicas=len(self.ranks), model_gar=self.gar_name,
+            fps=int(self.fps),
         )
 
 
@@ -819,6 +908,26 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
     who = f"cluster-ps-{pindex}"
     plane = _ModelPlane(ps_ranks, model_gar_name, fps, who)
 
+    # Telemetry (docs/TELEMETRY.md): same gradient-plane audit tap as the
+    # SSMW PS, plus the model-plane liveness events (plane_drop/readmit)
+    # and exchange waits through the global hook.
+    n_w = len(worker_ranks)
+    tele_hub, tele_exp = _telemetry_open(
+        args, who, num_ranks=n_w,
+        meta={"attack": getattr(args, "attack", None), "q": q,
+              "fps": int(fps), "model_gar": model_gar_name},
+    )
+    tap_fn = None
+    if tele_hub is not None:
+        from ..telemetry import taps as taps_lib
+
+        @jax.jit
+        def tap_fn(stack, sel):
+            bundle = taps_lib.compute_flat(
+                gar.name, stack, f, params=gar_params
+            )
+            return taps_lib.scatter(bundle, sel, n_w)
+
     @jax.jit
     def ps_update(flat_params, opt_state, grads_stack, step):
         if f or args.gar != "average":
@@ -934,6 +1043,13 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
             jnp.asarray(i, jnp.int32),
         )
         flat = np.asarray(flat_dev, np.float32)
+        if tele_hub is not None:
+            sel = jnp.asarray(
+                [k - worker_ranks[0] for k in sorted(got)[:q]], jnp.int32
+            )
+            tele_hub.record_step(
+                i, tap=tap_fn(jnp.asarray(np.stack(rows)), sel),
+            )
         losses_seen = i + 1
         if ckpt and args.checkpoint_freq and (i + 1) % args.checkpoint_freq == 0:
             ckpt.save(i + 1, {
@@ -972,6 +1088,7 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
         "steps": losses_seen,
         "wall_s": time.time() - t0,
     }
+    _telemetry_close(tele_hub, tele_exp)
     print(json.dumps({"tag": who, **summary}), flush=True)
     return summary
 
@@ -1121,6 +1238,11 @@ def _run_learn(args):
 
     who = f"cluster-node-{me}"
     warned_malformed = set()
+    # Events-only telemetry for LEARN peers: the gossip quorums carry no
+    # rank attribution after `harvest` stacks them, so this role streams
+    # exchange wait latencies + liveness events (the audit taps live on
+    # the PS roles and the on-mesh topologies).
+    tele_hub, tele_exp = _telemetry_open(args, who, num_ranks=n)
     t0 = time.time()
     base_key = jax.random.PRNGKey(args.seed + 1 + me)
     flat = np.asarray(flat0, np.float32)
@@ -1376,6 +1498,7 @@ def _run_learn(args):
             "dropped_at": dropped_at,
             "wall_s": time.time() - t0,
         }
+        _telemetry_close(tele_hub, tele_exp)
         print(json.dumps({"tag": who, **summary}), flush=True)
         return summary
     finally:

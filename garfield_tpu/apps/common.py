@@ -104,6 +104,17 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
       help="Number of simulated hosts the worker slots fold onto for "
            "--fault_crashes (default: one host per worker slot).")
     # --- new capabilities (absent in the reference) ---
+    a("--telemetry", type=str, nargs="?", const="telemetry", default=None,
+      metavar="DIR",
+      help="Enable the telemetry plane (docs/TELEMETRY.md): in-graph GAR "
+           "audit taps (per-rank selection masks/scores; cclip tau + clip "
+           "fraction), host-side aggregation with per-rank SUSPICION "
+           "scores (cumulative exclusion frequency under the active GAR), "
+           "and exporters — schema-versioned JSONL (DIR/telemetry.jsonl) "
+           "plus a Prometheus text snapshot (DIR/metrics.prom). DIR "
+           "defaults to ./telemetry. Costs one host sync + one extra "
+           "selection pass per step; disabled (the default) it traces "
+           "nothing and the trajectory is bitwise identical.")
     a("--checkpoint_dir", type=str, default=None,
       help="Directory for orbax checkpoints (reference has none).")
     a("--checkpoint_freq", type=int, default=1000,
@@ -277,6 +288,41 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                 f"[{tag}] --{flag} is not supported by this topology; ignored"
             )
 
+    # Telemetry plane (docs/TELEMETRY.md): hub + JSONL exporter, installed
+    # as the process-global event sink so exchange/liveness events land in
+    # the same stream as the per-step taps.
+    tele_hub = tele_exp = None
+    if getattr(args, "telemetry", None):
+        import os
+
+        from ..telemetry import exporters as tele_fmt, hub as tele_hub_lib
+
+        taps_supported = "telemetry" in trainer_params
+        if not taps_supported:
+            tools.warning(
+                f"[{tag}] --telemetry: this topology exposes no in-graph "
+                "taps; recording loss/timing/events only"
+            )
+        os.makedirs(args.telemetry, exist_ok=True)
+        tele_hub = tele_hub_lib.MetricsHub(
+            num_ranks=num_slots,
+            meta={
+                "tag": tag,
+                "gar": args.gar,
+                "attack": getattr(args, "attack", None),
+                "f": declared_f,
+                "num_slots": num_slots,
+                "dataset": args.dataset,
+                "model": args.model,
+                "seed": args.seed,
+            },
+        )
+        tele_hub_lib.install(tele_hub)
+        tele_exp = tele_fmt.JsonlExporter(
+            os.path.join(args.telemetry, "telemetry.jsonl")
+        )
+        tele_exp.write(tele_fmt.make_record("run", meta=tele_hub.meta))
+
     def build(step):
         kwargs = dict(make_trainer_kwargs)
         if getattr(args, "gar_dtype", None):
@@ -289,6 +335,8 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
             kwargs["worker_momentum"] = args.worker_momentum
         if getattr(args, "gar_params", None) and "gar_params" in trainer_params:
             kwargs["gar_params"] = args.gar_params
+        if tele_hub is not None and "telemetry" in trainer_params:
+            kwargs["telemetry"] = True
         if "num_iter" in trainer_params:
             # Run-length hint for the unroll-vs-vmap amortization choice
             # (core.slot_path_decision): REMAINING steps from this build
@@ -378,6 +426,15 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                 f"{profiling.convert_to_gbit(byz_bytes):.4f} Gbits",
                 flush=True,
             )
+        if tele_hub is not None:
+            # One host readback per step (the documented telemetry sync
+            # cost): the tap bundle is tiny — (n,) vectors + two scalars.
+            tele_exp.write(tele_hub.record_step(
+                i,
+                loss=float(metrics["loss"]),
+                tap=metrics.get("tap"),
+                step_time_s=timer.last() if args.bench else None,
+            ))
         if args.log:
             print(f"Loss {i}: {float(metrics['loss']):.6f}", flush=True)
         if args.acc_freq and i % args.acc_freq == 0:
@@ -428,6 +485,16 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
         **{f"step_{k}": v for k, v in timer.summary().items()},
     }
     print(json.dumps({"tag": tag, **summary}), flush=True)
+    if tele_hub is not None:
+        import os
+
+        from ..telemetry import exporters as tele_fmt, hub as tele_hub_lib
+
+        tele_exp.write(tele_hub.summary())
+        with open(os.path.join(args.telemetry, "metrics.prom"), "w") as fp:
+            fp.write(tele_fmt.prometheus_text(tele_hub))
+        tele_exp.close()
+        tele_hub_lib.uninstall()
     if ckpt:
         if args.checkpoint_freq:
             ckpt.save(args.num_iter, jax.tree.map(np.asarray, state))

@@ -8,8 +8,12 @@ itself), the web layer is stdlib ``http.server`` (no Quart in this image),
 and training runs in a background thread publishing progress:
 
   POST /train {"nodes": 8, "f": 1, "gar": "median", "attack": "lie"}
-  GET  /status -> {"running", "step", "total", "loss", "accuracy", ...}
-  GET  /       -> minimal HTML page driving the two endpoints
+  GET  /status -> {"running", "step", "total", "loss", "accuracy",
+                   "suspicion", "selection_history", ...}
+  GET  /metrics -> Prometheus text exposition of the telemetry hub
+                   (telemetry/exporters.prometheus_text)
+  GET  /       -> minimal HTML page driving the endpoints, with the
+                  GAR selection-history panel (who got excluded when)
 
   python -m garfield_tpu.apps.demo --port 8000
 """
@@ -26,6 +30,7 @@ import numpy as np
 
 from .. import data as data_lib, models as models_lib, parallel
 from ..parallel import learn
+from ..telemetry import MetricsHub, prometheus_text
 from ..utils import selectors, tools
 
 _PAGE = """<!doctype html>
@@ -48,6 +53,13 @@ _PAGE = """<!doctype html>
      last f ranks, trainer rank convention) draw red. -->
 <svg id=topo width=440 height=300></svg>
 <div id=nodes></div>
+<!-- Telemetry selection-history panel (docs/TELEMETRY.md): one row per
+     node, one cell per recent step; cell opacity = the GAR's selection
+     weight that step, so excluded (suspicious) nodes show as dark rows.
+     The bar on the right is the cumulative suspicion score. Raw series:
+     GET /metrics (Prometheus text). -->
+<h4 style="margin-bottom:4px">GAR selection history (telemetry)</h4>
+<div id=hist style="font-family:monospace;font-size:11px"></div>
 <pre id=out>idle</pre>
 <script>
 async function start(ev) {
@@ -91,9 +103,28 @@ function drawNodes(r) {
     `<div>node ${i}: ${byz[i] ? '<b style="color:#c0392b">byzantine</b>'
        : 'loss ' + (+l).toFixed(4)}</div>`).join('');
 }
+function drawHistory(r) {
+  const hist = r.selection_history || [], susp = r.suspicion || [];
+  const el = document.getElementById('hist');
+  if (!hist.length) { el.innerHTML = ''; return; }
+  const n = hist[0][1].length;
+  let rows = '';
+  for (let i = 0; i < n; i++) {
+    let cells = hist.map(([s, sel]) =>
+      `<span title="step ${s}: ${(+sel[i]).toFixed(2)}" style="display:` +
+      `inline-block;width:6px;height:12px;background:rgba(41,128,185,` +
+      `${Math.max(0.06, +sel[i])})"></span>`).join('');
+    const sp = susp[i] === undefined ? '' :
+      ` <span style="color:#c0392b">${(+susp[i]).toFixed(2)}</span>`;
+    rows += `<div>n${i} ${cells}${sp}</div>`;
+  }
+  el.innerHTML = rows +
+    '<div style="color:#888">cell = per-step selection weight; ' +
+    'red number = cumulative suspicion (exclusion frequency)</div>';
+}
 async function poll() {
   const r = await (await fetch('/status')).json();
-  drawTopo(r); drawNodes(r);
+  drawTopo(r); drawNodes(r); drawHistory(r);
   document.getElementById('out').textContent = JSON.stringify(r, null, 1);
   if (r.running) setTimeout(poll, 500);
 }
@@ -109,6 +140,7 @@ class DemoState:
         self.lock = threading.Lock()
         self.progress = {"running": False}
         self.thread = None
+        self.hub = None  # telemetry.MetricsHub of the active/last run
 
     def update(self, **kw):
         with self.lock:
@@ -148,7 +180,13 @@ def run_training(nodes, f, gar, attack, epochs, batch=16):
             num_nodes=nodes, f=f,
             attack=None if attack in (None, "none") else attack,
             mesh=mesh,
+            telemetry=True,  # feeds /metrics + the selection-history panel
         )
+        hub = MetricsHub(
+            num_ranks=nodes,
+            meta={"tag": "demo", "gar": gar, "attack": attack, "f": f},
+        )
+        STATE.hub = hub
         state = init_fn(jax.random.PRNGKey(1234), xs[0, 0])
         xs = jax.device_put(jax.numpy.asarray(xs), step_fn.batch_sharding)
         ys = jax.device_put(jax.numpy.asarray(ys), step_fn.batch_sharding)
@@ -161,6 +199,7 @@ def run_training(nodes, f, gar, attack, epochs, batch=16):
 
         def publish(i, metrics, running, done=False):
             acc = parallel.compute_accuracy(state, eval_fn, test, binary=True)
+            susp = hub.suspicion()
             STATE.update(
                 running=running, step=i + 1, total=total,
                 epoch=i // iters_per_epoch,
@@ -171,11 +210,18 @@ def run_training(nodes, f, gar, attack, epochs, batch=16):
                 ],
                 byz_nodes=byz, done=done,
                 elapsed_s=round(time.time() - t0, 1),
+                suspicion=(
+                    None if susp is None
+                    else [round(float(s), 4) for s in susp]
+                ),
+                selection_history=hub.selection_history(60),
             )
 
         for i in range(total):
             state, metrics = step_fn(state, xs[:, i % iters_per_epoch],
                                      ys[:, i % iters_per_epoch])
+            hub.record_step(i, loss=float(metrics["loss"]),
+                            tap=metrics.get("tap"))
             if i % iters_per_epoch == 0 or i == total - 1:
                 publish(i, metrics, running=True)
         publish(total - 1, metrics, running=False, done=True)
@@ -197,6 +243,12 @@ class Handler(BaseHTTPRequestHandler):
             self._send(200, _PAGE, "text/html")
         elif self.path == "/status":
             self._send(200, json.dumps(STATE.snapshot()))
+        elif self.path == "/metrics":
+            # Prometheus text exposition (format 0.0.4) of the live hub —
+            # scrape-able the moment a run starts; empty before any run.
+            hub = STATE.hub
+            body = prometheus_text(hub) if hub is not None else ""
+            self._send(200, body, "text/plain; version=0.0.4")
         else:
             self._send(404, json.dumps({"error": "not found"}))
 

@@ -38,6 +38,7 @@ from ..attacks import (
     apply_gradient_attack_tree,
     gradient_attacks,
 )
+from ..telemetry import taps as taps_lib
 from . import core, fold, mesh as mesh_lib
 
 __all__ = ["make_trainer"]
@@ -128,8 +129,17 @@ def make_trainer(
     worker_momentum=None,
     gar_params=None,
     num_iter=None,
+    telemetry=False,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the SSMW topology.
+
+    ``telemetry`` (default off) makes ``step_fn`` return a fixed-shape
+    ``TapBundle`` under ``metrics["tap"]`` — per-rank selection evidence
+    recomputed from the same poisoned stack and keys the GAR consumed
+    (telemetry/taps.py). Off means NOTHING tap-shaped is traced: the
+    step program is byte-identical to the pre-telemetry one, and the
+    taps never write into TrainState, so taps-on trajectories are
+    bitwise equal to taps-off (tests/test_telemetry.py).
 
     Args mirror the reference CLI (Aggregathor/trainer.py:62-135): ``f`` is
     the declared tolerance passed to the GAR; ``attack``/``byz_mask`` control
@@ -195,6 +205,13 @@ def make_trainer(
         )
     n_eff = subset if subset is not None else num_workers
     _check_gar(gar, n_eff, f)
+    if telemetry and granularity == "layer":
+        raise ValueError(
+            "telemetry taps report one whole-model selection per rank; "
+            'granularity="layer" runs an independent GAR per tensor, '
+            "which has no single per-rank mask — run taps at model "
+            "granularity"
+        )
     if worker_momentum is not None and not (0.0 <= worker_momentum < 1.0):
         raise ValueError(
             f"worker_momentum must be in [0, 1), got {worker_momentum}"
@@ -401,7 +418,37 @@ def make_trainer(
             worker_mom=new_mom,
             gar_state=new_gar_state,
         )
-        return new_state, {"loss": mean_loss}
+        metrics = {"loss": mean_loss}
+        if telemetry:
+            # In-graph audit tap (telemetry/taps.py): recompute the
+            # poisoned flat stack with the SAME keys the aggregation used
+            # — on the flat path XLA CSEs this against the rule's own
+            # pass; on the tree/fold paths it is the enabled-only
+            # overhead the docstring prices. Nothing here flows into
+            # new_state, so the trajectory is untouched.
+            flat_raw = core.flatten_rows(grads)
+            poisoned = apply_gradient_attack(
+                attack, flat_raw, byz_mask, key=atk_key, **attack_params
+            )
+            tap_center = (
+                ravel_pytree(state.gar_state)[0]
+                if gar.stateful_center else None
+            )
+            if subset is not None and subset < num_workers:
+                tap_sel = core.subset_indices(sub_key, num_workers, subset)
+                bundle = taps_lib.compute_flat(
+                    gar.name, poisoned[tap_sel], f, key=gar_key,
+                    params=gar_params, center=tap_center,
+                )
+                metrics["tap"] = taps_lib.scatter(
+                    bundle, tap_sel, num_workers
+                )
+            else:
+                metrics["tap"] = taps_lib.compute_flat(
+                    gar.name, poisoned, f, key=gar_key, params=gar_params,
+                    center=tap_center,
+                )
+        return new_state, metrics
 
     sharded_step = mesh_lib.shard_map(
         _local_step,

@@ -54,6 +54,7 @@ from ..attacks import (
     apply_model_attack_rows,
     model_attacks,
 )
+from ..telemetry import taps as taps_lib
 from . import core, fold, mesh as mesh_lib
 from .aggregathor import _check_gar, _resolve_gar, _tree_path_ok
 
@@ -88,8 +89,18 @@ def make_trainer(
     gar_params=None,
     model_gar_params=None,
     num_iter=None,
+    telemetry=False,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the MSMW topology.
+
+    ``telemetry`` adds ``metrics["tap"]`` — the WORKER-gradient plane's
+    ``TapBundle`` (telemetry/taps.py), averaged across the num_ps server
+    views (each PS evaluates the workers against its own replica and,
+    under ``subset``, its own quorum): ``observed`` is the fraction of
+    servers whose quorum contained the worker, ``selected`` the mean
+    influence its gradient earned. The model gather plane is not tapped
+    (PS models are few and the per-worker audit is the signal). Off by
+    default — nothing tap-shaped is traced, taps never enter TrainState.
 
     ``gar`` aggregates gradients with tolerance ``fw``; ``model_gar``
     (default: same rule) aggregates server models with tolerance ``fps`` —
@@ -160,6 +171,12 @@ def make_trainer(
         )
     n_eff = subset if subset is not None else num_workers
     _check_gar(gar, n_eff, fw)
+    if telemetry and granularity == "layer":
+        raise ValueError(
+            "telemetry taps report one whole-model selection per rank; "
+            'granularity="layer" has no single per-rank mask — run taps '
+            "at model granularity"
+        )
     per_w = mesh_lib.fold(num_workers, mesh.shape[axis], "workers")
     per_ps = mesh_lib.fold(num_ps, mesh.shape[ps_axis], "servers")
     if model_subset is not None and not (1 <= model_subset <= num_ps):
@@ -305,6 +322,7 @@ def make_trainer(
             lambda *ls: jnp.stack(ls), *[o[2] for o in outs]
         )
 
+        tap = None
         if tree_ok:
             # Tree-mode gradient phase: per-PS attack + GAR + update, all
             # on the stacked TREE (unrolled over the O(1) local PS slots;
@@ -337,6 +355,26 @@ def make_trainer(
                 lambda *ls: jnp.stack(ls), *new_params_list
             )
             new_opt = jax.tree.map(lambda *ls: jnp.stack(ls), *new_opt_list)
+            if telemetry:
+                # Per-PS audit taps on the gradient plane (no subsets on
+                # this branch — see tree_ok): each slot's gathered tree
+                # differs (its own replica's gradients), so tap each and
+                # average; pmean folds in the other PS shards.
+                bundles = [
+                    taps_lib.compute_flat(
+                        gar.name,
+                        apply_gradient_attack(
+                            attack, core.flatten_rows(outs[k][0]),
+                            byz_worker_mask, key=atk_key, **attack_params,
+                        ),
+                        fw, key=jax.random.fold_in(gar_key, ps_ids[k]),
+                        params=gar_params,
+                    )
+                    for k in range(per_ps)
+                ]
+                tap = taps_lib.mean_bundles(
+                    jax.tree.map(lambda *ls: jnp.stack(ls), *bundles)
+                )
         else:
             stacks = jnp.stack([o[0] for o in outs])  # (per_ps, n_w, d)
             stacks = jax.vmap(
@@ -349,6 +387,28 @@ def make_trainer(
                 _ps_slot_step, in_axes=(0, 0, 0, 0, None)
             )(ps_ids, state.params, state.opt_state, stacks,
               (sub_key, gar_key))
+            if telemetry:
+                def one_tap(ps_id, stack):
+                    # SAME (sel, key) derivation as _ps_slot_step, so the
+                    # tap audits exactly the quorum this PS aggregated.
+                    gkey = jax.random.fold_in(gar_key, ps_id)
+                    if subset is not None and subset < num_workers:
+                        sel = core.subset_indices(
+                            jax.random.fold_in(sub_key, ps_id),
+                            num_workers, subset,
+                        )
+                        bundle = taps_lib.compute_flat(
+                            gar.name, stack[sel], fw, key=gkey,
+                            params=gar_params,
+                        )
+                        return taps_lib.scatter(bundle, sel, num_workers)
+                    return taps_lib.compute_flat(
+                        gar.name, stack, fw, key=gkey, params=gar_params,
+                    )
+
+                tap = taps_lib.mean_bundles(
+                    jax.vmap(one_tap)(ps_ids, stacks)
+                )
 
         # --- model gather phase (ByzSGD/trainer.py:240-244) ----------------
         flat_models = core.flatten_rows(new_params)  # (per_ps, d)
@@ -453,6 +513,13 @@ def make_trainer(
         )
         new_ms = jax.tree.map(lambda l: jax.lax.pmean(l, ps_axis), new_ms)
 
+        metrics = {"loss": mean_loss}
+        if telemetry:
+            # Observer mean over ALL num_ps server views (the local slots
+            # were averaged where `tap` was built).
+            metrics["tap"] = jax.tree.map(
+                lambda l: jax.lax.pmean(l, ps_axis), tap
+            )
         return (
             state.replace(
                 step=state.step + 1,
@@ -460,7 +527,7 @@ def make_trainer(
                 model_state=new_ms,
                 opt_state=new_opt,
             ),
-            {"loss": mean_loss},
+            metrics,
         )
 
     sharded_step = mesh_lib.shard_map(

@@ -80,6 +80,7 @@ from ..attacks import (
     apply_gradient_attack_tree,
     apply_model_attack_rows,
 )
+from ..telemetry import taps as taps_lib
 from . import core, fold, mesh as mesh_lib
 from .aggregathor import _check_gar, _resolve_gar, _tree_path_ok
 
@@ -111,8 +112,19 @@ def make_trainer(
     gar_params=None,
     tree_path=True,
     num_iter=None,
+    telemetry=False,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the LEARN topology.
+
+    ``telemetry`` adds ``metrics["tap"]`` — the phase-2 gradient
+    exchange's ``TapBundle`` (telemetry/taps.py). Under per-node
+    wait-n-f subsets the exported bundle is the OBSERVER MEAN across all
+    n nodes' views: ``observed`` is the fraction of nodes whose quorum
+    contained the rank, ``selected`` the mean influence its gradient
+    earned. Agreement rounds and the model gossip are not tapped (the
+    phase-2 selection is the per-rank audit signal). Off by default:
+    nothing tap-shaped is traced, and taps never enter TrainState —
+    taps-on trajectories are bitwise equal to taps-off.
 
     ``non_iid=True`` enables the ceil(log2 t) agreement rounds
     (LEARN/trainer.py:251-252 runs them only for non-iid data); ``max_rounds``
@@ -432,6 +444,41 @@ def make_trainer(
             aggr_local = phase2(None, None)
 
         metrics_extra = {}
+        if telemetry:
+            # Phase-2 audit tap: the poisoned gathered stack rebuilt with
+            # the SAME atk_key the exchange used (CSE'd on the flat path;
+            # the enabled-only extra pass on the tree/fold paths). cclip
+            # taps here use the rule's median-init center — the per-node
+            # carried centers differ across observers (taps.py caveats).
+            stack0p = apply_gradient_attack(
+                attack, core.flatten_rows(gathered), byz_mask, key=atk_key,
+                **attack_params,
+            )
+            if waiting:
+                def one_tap(nid):
+                    # SAME (sel, key) derivation as node_aggregate /
+                    # node_subset_keys, so the tap audits exactly the
+                    # quorum node ``nid`` aggregated.
+                    sel_key, gkey = jax.random.split(
+                        jax.random.fold_in(sub_key, nid)
+                    )
+                    sel = core.subset_indices(sel_key, num_nodes, subset)
+                    bundle = taps_lib.compute_flat(
+                        gar.name, stack0p[sel], f, key=gkey,
+                        params=gar_params,
+                    )
+                    return taps_lib.scatter(bundle, sel, num_nodes)
+
+                local_mean = taps_lib.mean_bundles(
+                    jax.vmap(one_tap)(node_ids)
+                )
+                metrics_extra["tap"] = jax.tree.map(
+                    lambda l: jax.lax.pmean(l, axis), local_mean
+                )
+            else:
+                metrics_extra["tap"] = taps_lib.compute_flat(
+                    gar.name, stack0p, f, key=sub_key, params=gar_params,
+                )
         if track_spread:
             metrics_extra["aggr_spread_pre"] = honest_spread(
                 aggr_rows_of(aggr_local)

@@ -1,0 +1,232 @@
+"""Telemetry exporters: schema-versioned JSONL, Prometheus text, validation.
+
+One record schema serves every producer (training loops, the cluster
+driver, ``bench.py``, ``gar_bench.py``) so consumers — the driver's
+BENCH_r* capture, dashboards, the tier-1 schema check — parse one format:
+
+    {"schema": "garfield-telemetry", "v": 1, "kind": <kind>, ...}
+
+Kinds: ``run`` (header: config/meta), ``step`` (per-step tap + loss +
+timing), ``event`` (liveness / exchange waits), ``summary`` (run-closing
+suspicion + counters), ``bench`` (bench.py's north-star line), and
+``gar_bench`` (per-cell kernel latencies). ``validate_record`` /
+``validate_jsonl`` are stdlib-only and run in the tier-1 suite, so a
+malformed artifact fails loudly instead of going dark (the BENCH_r05
+rc=1 post-mortem this subsystem exists for).
+"""
+
+import json
+import numbers
+
+__all__ = [
+    "SCHEMA",
+    "SCHEMA_VERSION",
+    "JsonlExporter",
+    "make_record",
+    "prometheus_text",
+    "append_record",
+    "validate_record",
+    "validate_jsonl",
+]
+
+SCHEMA = "garfield-telemetry"
+SCHEMA_VERSION = 1
+
+KINDS = ("run", "step", "event", "summary", "bench", "gar_bench")
+
+
+def make_record(kind, **fields):
+    """Stamp ``fields`` with the schema envelope."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown telemetry record kind {kind!r}")
+    return {"schema": SCHEMA, "v": SCHEMA_VERSION, "kind": kind, **fields}
+
+
+class JsonlExporter:
+    """Line-buffered JSONL writer (one record per line, flushed — a
+    crashed run keeps every record written before the crash)."""
+
+    def __init__(self, path, append=False):
+        self.path = str(path)
+        self._fp = open(self.path, "a" if append else "w")
+
+    def write(self, record):
+        validate_record(record)
+        self._fp.write(json.dumps(record) + "\n")
+        self._fp.flush()
+        return record
+
+    def close(self):
+        if self._fp is not None:
+            self._fp.close()
+            self._fp = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def append_record(path, record):
+    """One-shot append (bench entry points: no long-lived exporter)."""
+    validate_record(record)
+    with open(path, "a") as fp:
+        fp.write(json.dumps(record) + "\n")
+    return record
+
+
+# --- validation (stdlib only) ----------------------------------------------
+
+
+def _fail(msg):
+    raise ValueError(f"telemetry schema violation: {msg}")
+
+
+def _is_num(v):
+    return isinstance(v, numbers.Real) and not isinstance(v, bool)
+
+
+def _check_float_list(rec_kind, name, val, length=None):
+    if not isinstance(val, list) or not all(_is_num(x) for x in val):
+        _fail(f"{rec_kind}.{name} must be a list of numbers, got {val!r}")
+    if length is not None and len(val) != length:
+        _fail(
+            f"{rec_kind}.{name} has {len(val)} entries, expected {length}"
+        )
+
+
+def validate_record(rec):
+    """Raise ValueError unless ``rec`` is a well-formed telemetry record."""
+    if not isinstance(rec, dict):
+        _fail(f"record must be an object, got {type(rec).__name__}")
+    if rec.get("schema") != SCHEMA:
+        _fail(f"schema must be {SCHEMA!r}, got {rec.get('schema')!r}")
+    v = rec.get("v")
+    if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+        _fail(f"v must be a positive int, got {v!r}")
+    kind = rec.get("kind")
+    if kind not in KINDS:
+        _fail(f"kind must be one of {KINDS}, got {kind!r}")
+    if kind == "step":
+        step = rec.get("step")
+        if not isinstance(step, int) or isinstance(step, bool) or step < 0:
+            _fail(f"step.step must be a non-negative int, got {step!r}")
+        for key in ("loss", "step_time_s"):
+            val = rec.get(key)
+            if val is not None and not _is_num(val):
+                _fail(f"step.{key} must be a number or null, got {val!r}")
+        tap = rec.get("tap")
+        if tap is not None:
+            if not isinstance(tap, dict):
+                _fail(f"step.tap must be an object, got {tap!r}")
+            obs = tap.get("observed")
+            _check_float_list("tap", "observed", obs)
+            for key in ("selected", "score"):
+                _check_float_list("tap", key, tap.get(key), len(obs))
+            for key in ("tau", "clip_frac"):
+                if not _is_num(tap.get(key)):
+                    _fail(f"tap.{key} must be a number, got {tap.get(key)!r}")
+    elif kind == "event":
+        if not isinstance(rec.get("event"), str):
+            _fail(f"event.event must be a string, got {rec.get('event')!r}")
+    elif kind == "summary":
+        for key in ("steps", "events"):
+            val = rec.get(key)
+            if not isinstance(val, int) or isinstance(val, bool) or val < 0:
+                _fail(f"summary.{key} must be a non-negative int, got {val!r}")
+        if rec.get("suspicion") is not None:
+            _check_float_list("summary", "suspicion", rec["suspicion"])
+    elif kind == "bench":
+        if not isinstance(rec.get("metric"), str):
+            _fail(f"bench.metric must be a string, got {rec.get('metric')!r}")
+        val = rec.get("value")
+        if val is not None and not _is_num(val):
+            _fail(f"bench.value must be a number or null, got {val!r}")
+    elif kind == "gar_bench":
+        if not isinstance(rec.get("gar"), str):
+            _fail(f"gar_bench.gar must be a string, got {rec.get('gar')!r}")
+        for key in ("n", "f", "d"):
+            val = rec.get(key)
+            if not isinstance(val, int) or isinstance(val, bool):
+                _fail(f"gar_bench.{key} must be an int, got {val!r}")
+        lat = rec.get("latency_s")
+        if lat is not None and not _is_num(lat):
+            _fail(f"gar_bench.latency_s must be a number or null, got {lat!r}")
+    # kind == "run": meta payload is free-form (validated as JSON above).
+    return rec
+
+
+def validate_jsonl(path):
+    """Validate every line of a JSONL artifact; returns the record count."""
+    count = 0
+    with open(path) as fp:
+        for lineno, line in enumerate(fp, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                _fail(f"{path}:{lineno} is not JSON: {e}")
+            try:
+                validate_record(rec)
+            except ValueError as e:
+                raise ValueError(f"{path}:{lineno}: {e}") from None
+            count += 1
+    return count
+
+
+# --- Prometheus text exposition --------------------------------------------
+
+
+def prometheus_text(hub):
+    """Prometheus text-format snapshot of a ``MetricsHub`` (exposition
+    format 0.0.4 — what ``GET /metrics`` on apps/demo.py serves)."""
+    lines = []
+
+    def metric(name, mtype, help_, samples):
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            if value is None:
+                continue
+            label_s = (
+                "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+                if labels else ""
+            )
+            lines.append(f"{name}{label_s} {value:g}")
+
+    c = hub.counters()
+    metric("garfield_steps_total", "counter",
+           "Training steps folded into the hub.", [({}, c["steps"])])
+    metric("garfield_events_total", "counter",
+           "Liveness/exchange events folded into the hub.",
+           [({}, c["events"])])
+    metric("garfield_loss", "gauge", "Last recorded training loss.",
+           [({}, c["loss"])])
+    metric("garfield_gar_tau", "gauge",
+           "cclip clip threshold at the last tapped step (0 for other "
+           "rules).", [({}, c["tau"])])
+    metric("garfield_gar_clip_fraction", "gauge",
+           "Fraction of ranks clipped at the last tapped step.",
+           [({}, c["clip_frac"])])
+    st = hub.step_time_stats()
+    metric("garfield_step_time_seconds", "gauge",
+           "Mean recorded step wall time.",
+           [({}, None if st is None else st["mean_s"])])
+    susp = hub.suspicion()
+    if susp is not None:
+        metric("garfield_rank_suspicion", "gauge",
+               "Cumulative exclusion frequency per rank under the active "
+               "GAR (the Byzantine-audit signal).",
+               [({"rank": str(i)}, float(s)) for i, s in enumerate(susp)])
+        metric("garfield_rank_observed_total", "counter",
+               "Quorum appearances per rank.",
+               [({"rank": str(i)}, float(o))
+                for i, o in enumerate(hub._observed)])
+        metric("garfield_rank_excluded_total", "counter",
+               "Cumulative refused influence per rank.",
+               [({"rank": str(i)}, float(e))
+                for i, e in enumerate(hub._excluded)])
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,239 @@
+"""Host-side metrics aggregation: ring buffer, suspicion scores, events.
+
+``MetricsHub`` is the single host-side sink of the telemetry plane: the
+training loops feed it per-step taps (``record_step``), the cluster
+driver and ``utils.exchange`` feed it liveness / wait-n-f events through
+the process-global hook (``install`` + ``emit_event`` — a no-op when no
+hub is installed, so instrumented code paths cost nothing un-telemetered).
+
+The derived audit signal is the per-rank **suspicion score**: the
+cumulative exclusion frequency under the active GAR,
+
+    suspicion[i] = sum_steps (observed[i] - selected[i]) /
+                   sum_steps  observed[i]
+
+i.e. "of the quorums that contained rank i, what fraction of influence
+did the rule refuse it". Byzantine ranks that a robust rule keeps
+rejecting converge to suspicion ~1 while honest ranks stay near 0 — the
+audit that makes Byzantine ranks visible without ground truth (asserted
+end-to-end in tests/test_telemetry.py under the lie attack).
+"""
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+from .exporters import make_record
+
+__all__ = ["MetricsHub", "install", "uninstall", "current", "emit_event"]
+
+
+class MetricsHub:
+    """Ring-buffered aggregation of taps, timings and liveness events.
+
+    Thread-safe: the cluster driver's exchange threads emit events
+    concurrently with the training loop's ``record_step``.
+    """
+
+    def __init__(self, num_ranks=None, capacity=2048, meta=None, sink=None):
+        self.num_ranks = num_ranks
+        self.meta = dict(meta or {})
+        # Optional streaming sink (a JsonlExporter): every record is
+        # written as it is recorded — crash-safe for the cluster roles,
+        # whose exchange threads emit events the training loop never sees.
+        self._sink = sink
+        self._lock = threading.Lock()
+        self._ring = collections.deque(maxlen=capacity)
+        self._steps = 0
+        self._events = 0
+        self._last_loss = None
+        self._last_tau = 0.0
+        self._last_clip_frac = 0.0
+        self._step_times = []
+        self._observed = None
+        self._excluded = None
+        self._selected_hist = collections.deque(maxlen=120)
+
+    # --- feeding -----------------------------------------------------------
+
+    def _ensure_ranks(self, n):
+        if self._observed is None:
+            self.num_ranks = n
+            self._observed = np.zeros(n, np.float64)
+            self._excluded = np.zeros(n, np.float64)
+
+    def record_step(self, step, *, loss=None, tap=None, step_time_s=None,
+                    extra=None):
+        """Fold one training step into the hub; returns the JSONL record."""
+        tap_host = None
+        if tap is not None:
+            tap_host = {
+                "observed": np.asarray(tap["observed"], np.float64),
+                "selected": np.asarray(tap["selected"], np.float64),
+                "score": np.asarray(tap["score"], np.float64),
+                "tau": float(np.asarray(tap["tau"])),
+                "clip_frac": float(np.asarray(tap["clip_frac"])),
+            }
+        with self._lock:
+            self._steps += 1
+            if loss is not None:
+                self._last_loss = float(loss)
+            if step_time_s is not None:
+                self._step_times.append(float(step_time_s))
+            if tap_host is not None:
+                obs, sel = tap_host["observed"], tap_host["selected"]
+                self._ensure_ranks(obs.size)
+                self._observed += obs
+                # A rank's per-step exclusion is the influence the rule
+                # refused it, bounded by how much of it was observed at
+                # all (multi-observer bundles report fractions of both).
+                self._excluded += np.maximum(obs - np.minimum(sel, obs), 0.0)
+                self._last_tau = tap_host["tau"]
+                self._last_clip_frac = tap_host["clip_frac"]
+                self._selected_hist.append(
+                    (int(step), np.round(sel, 5).tolist())
+                )
+            rec = make_record(
+                "step",
+                step=int(step),
+                loss=None if loss is None else float(loss),
+                step_time_s=(
+                    None if step_time_s is None else float(step_time_s)
+                ),
+                tap=None if tap_host is None else {
+                    "observed": np.round(tap_host["observed"], 6).tolist(),
+                    "selected": np.round(tap_host["selected"], 6).tolist(),
+                    "score": np.round(tap_host["score"], 6).tolist(),
+                    "tau": tap_host["tau"],
+                    "clip_frac": tap_host["clip_frac"],
+                },
+                **(extra or {}),
+            )
+            self._ring.append(rec)
+            self._drain(rec)
+            return rec
+
+    def record_event(self, kind, **fields):
+        """Fold one liveness/exchange event (e.g. ``exchange_wait``,
+        ``quorum_exclusion``, ``plane_drop``); returns the record."""
+        rec = make_record("event", event=str(kind), t=time.time(), **fields)
+        with self._lock:
+            self._events += 1
+            self._ring.append(rec)
+            self._drain(rec)
+            return rec
+
+    def _drain(self, rec):
+        if self._sink is not None:
+            try:
+                self._sink.write(rec)
+            except Exception:
+                pass  # a full disk must not take down the data path
+
+    # --- reading -----------------------------------------------------------
+
+    def suspicion(self):
+        """Per-rank cumulative exclusion frequency, or None before any tap."""
+        with self._lock:
+            if self._observed is None:
+                return None
+            return self._excluded / np.maximum(self._observed, 1e-9)
+
+    def selection_history(self, k=60):
+        """Last k (step, selected-list) pairs — the demo's history panel."""
+        with self._lock:
+            return list(self._selected_hist)[-k:]
+
+    def records(self):
+        with self._lock:
+            return list(self._ring)
+
+    def counters(self):
+        with self._lock:
+            return {
+                "steps": self._steps,
+                "events": self._events,
+                "loss": self._last_loss,
+                "tau": self._last_tau,
+                "clip_frac": self._last_clip_frac,
+            }
+
+    def step_time_stats(self):
+        with self._lock:
+            if not self._step_times:
+                return None
+            a = np.asarray(self._step_times)
+            return {
+                "count": int(a.size),
+                "mean_s": float(a.mean()),
+                "min_s": float(a.min()),
+                "max_s": float(a.max()),
+            }
+
+    def summary(self):
+        """The run-closing JSONL record: suspicion, counters, timings."""
+        susp = self.suspicion()
+        with self._lock:
+            return make_record(
+                "summary",
+                steps=self._steps,
+                events=self._events,
+                loss=self._last_loss,
+                num_ranks=self.num_ranks,
+                suspicion=(
+                    None if susp is None else np.round(susp, 6).tolist()
+                ),
+                observed=(
+                    None if self._observed is None
+                    else np.round(self._observed, 3).tolist()
+                ),
+                excluded=(
+                    None if self._excluded is None
+                    else np.round(self._excluded, 3).tolist()
+                ),
+                step_time=(
+                    None if not self._step_times else {
+                        "count": len(self._step_times),
+                        "mean_s": float(np.mean(self._step_times)),
+                    }
+                ),
+                meta=self.meta,
+            )
+
+
+# --- process-global hook ----------------------------------------------------
+#
+# The exchange layer and the cluster driver sit far from the training loop
+# that owns the hub; they report through this module-level slot instead of
+# threading a handle through every call. ``emit_event`` is a cheap no-op
+# when nothing is installed, so the instrumented paths stay free in
+# un-telemetered runs.
+
+_GLOBAL = None
+
+
+def install(hub):
+    """Make ``hub`` the process-global event sink (returns the previous)."""
+    global _GLOBAL
+    prev, _GLOBAL = _GLOBAL, hub
+    return prev
+
+
+def uninstall():
+    global _GLOBAL
+    _GLOBAL = None
+
+
+def current():
+    return _GLOBAL
+
+
+def emit_event(kind, **fields):
+    hub = _GLOBAL
+    if hub is not None:
+        try:
+            hub.record_event(kind, **fields)
+        except Exception:
+            pass  # telemetry must never take down the data path

@@ -1,0 +1,258 @@
+"""In-graph GAR audit taps: fixed-shape selection evidence per step.
+
+A ``TapBundle`` is a plain dict pytree with one fixed layout for every
+rule, so the host aggregator and the exporters never branch on the GAR:
+
+  - ``observed``  (n,) f32 — 1 where the rank's row was inside the quorum
+    the rule aggregated (the wait-n-f subset; all-ones without subsets);
+  - ``selected``  (n,) f32 in [0, 1] — the rank's influence on the
+    aggregate: a hard 0/1 selection indicator for selection rules
+    (krum, bulyan, brute, aksel), the final clip weight for cclip, and
+    the (clipped) share of coordinate wins for the coordinate-wise rules
+    (median, tmean) — "median fall-through";
+  - ``score``     (n,) f32 — the rule's own per-rank score (krum's
+    distance score, aksel's distance-to-median, cclip's radii, the
+    coordinate-win share for median/tmean). Semantics are per-rule; the
+    suspicion statistic uses only ``observed``/``selected``;
+  - ``tau``       () f32 — cclip's final clip threshold (0 elsewhere);
+  - ``clip_frac`` () f32 — fraction of observed ranks cclip clipped
+    (0 elsewhere).
+
+Taps are recomputed from the SAME poisoned stack and PRNG keys the GAR
+consumed, so they are pure observers: nothing they compute flows into
+``TrainState``, which is what makes taps-on trajectories bitwise equal to
+taps-off (asserted in tests/test_telemetry.py). On the flat aggregation
+path XLA CSEs the recomputation against the rule's own; on the tree/fold
+fast paths the tap pays one extra flatten + attack + selection pass —
+only when telemetry is enabled (the topologies trace no tap code when it
+is off).
+
+Caveats (documented, deliberate): randomized attacks (random/drop) fold
+their key per LEAF on the tree where-path, so the tap — computed on the
+flat stack — sees a distributionally-identical but not bitwise-equal
+poison there; cclip taps in the LEARN topology use a median-init center
+(the per-node carried centers differ across observers); ``condense``'s
+coordinate-Bernoulli mixing has no per-rank selection, so it reports the
+uniform fallback bundle.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TAP_KEYS", "zeros", "compute_flat", "scatter"]
+
+TAP_KEYS = ("observed", "selected", "score", "tau", "clip_frac")
+
+
+def zeros(n):
+    """All-zero TapBundle template for n logical ranks."""
+    return {
+        "observed": jnp.zeros((n,), jnp.float32),
+        "selected": jnp.zeros((n,), jnp.float32),
+        "score": jnp.zeros((n,), jnp.float32),
+        "tau": jnp.zeros((), jnp.float32),
+        "clip_frac": jnp.zeros((), jnp.float32),
+    }
+
+
+def _uniform(n):
+    b = zeros(n)
+    b["observed"] = jnp.ones((n,), jnp.float32)
+    b["selected"] = jnp.ones((n,), jnp.float32)
+    return b
+
+
+def _coordinate_share(stack, member):
+    """(n,) share of coordinate wins from a boolean membership matrix.
+
+    ``member[i, d]`` marks row i's value as surviving at coordinate d
+    (equal to the median / inside the trimmed window). Shares are
+    normalized per coordinate (ties split the win) and averaged over d,
+    then scaled by n so the uniform rule reports 1.0 — the "median
+    fall-through" signal: an excluded rank's share collapses toward 0.
+    """
+    n = stack.shape[0]
+    cnt = jnp.maximum(jnp.sum(member, axis=0, keepdims=True), 1)
+    share = jnp.mean(member / cnt, axis=1)  # (n,), sums to ~1 over ranks
+    return jnp.clip(n * share, 0.0, 1.0), share
+
+
+def _tap_krum(stack, f, params):
+    from ..aggregators import krum as _krum
+    from ..aggregators._common import pairwise_distances
+
+    n = stack.shape[0]
+    m = params.get("m") or n - f - 2
+    dist = pairwise_distances(stack)
+    score = _krum._scores_from_dist(dist, n, f)
+    w = _krum._selection_weights_from_dist(dist, n, f, m)
+    b = _uniform(n)
+    b["selected"] = (w > 0).astype(jnp.float32)
+    b["score"] = jnp.nan_to_num(score, posinf=0.0).astype(jnp.float32)
+    return b
+
+
+def _tap_brute(stack, f, params):
+    from ..aggregators import brute as _brute
+    from ..aggregators._common import pairwise_distances
+
+    n = stack.shape[0]
+    w = _brute._selection_weights_from_dist(
+        pairwise_distances(stack, exclude_self=False), n, f
+    )
+    b = _uniform(n)
+    b["selected"] = (w > 0).astype(jnp.float32)
+    b["score"] = b["selected"]
+    return b
+
+
+def _tap_bulyan(stack, f, params):
+    from ..aggregators import bulyan as _bulyan
+    from ..aggregators._common import pairwise_distances
+
+    n = stack.shape[0]
+    m = params.get("m") or n - f - 2
+    dist = pairwise_distances(stack)
+    weights = _bulyan._selection_weight_matrix(dist, n, f, m, jnp.float32)
+    wsum = jnp.sum(weights, axis=0)  # total phase-1 influence per rank
+    b = _uniform(n)
+    b["selected"] = (wsum > 0).astype(jnp.float32)
+    b["score"] = wsum
+    return b
+
+
+def _tap_aksel(stack, f, params):
+    from ..aggregators import aksel as _aksel
+    from ..aggregators._common import coordinate_median
+
+    n = stack.shape[0]
+    mode = params.get("mode", "mid")
+    med = coordinate_median(stack)
+    dist = jnp.sum(
+        jnp.square((stack - med[None, :]).astype(jnp.float32)), axis=1
+    )
+    w = _aksel._weights(dist, n, _aksel._count(n, f, mode))
+    b = _uniform(n)
+    b["selected"] = (w > 0).astype(jnp.float32)
+    b["score"] = jnp.nan_to_num(dist, posinf=0.0)
+    return b
+
+
+def _tap_cclip(stack, f, params, center):
+    """Replays cclip's fixed-point iterations (aggregators/cclip.py
+    ``_clip_step``) to expose the final radii, tau and clip weights."""
+    from ..aggregators import cclip as _cclip
+    from ..aggregators._common import coordinate_median
+
+    n = stack.shape[0]
+    iters = int(params.get("iters", _cclip.ITERS))
+    tau_cfg = params.get("tau")
+    eps = jnp.asarray(1e-12, jnp.float32)
+    if center is None:
+        center = coordinate_median(stack).astype(jnp.float32)
+    else:
+        center = center.astype(jnp.float32)
+    norms = jnp.zeros((n,), jnp.float32)
+    tau_l = jnp.zeros((), jnp.float32)
+    scale = jnp.ones((n,), jnp.float32)
+    for _ in range(iters):
+        dev = stack - center[None, :]
+        dev = jnp.nan_to_num(dev, nan=0.0, posinf=0.0, neginf=0.0)
+        norms = jnp.sqrt(
+            jnp.sum(jnp.square(dev.astype(jnp.float32)), axis=1)
+        )
+        tau_l = jnp.median(norms) if tau_cfg is None else jnp.asarray(
+            tau_cfg, jnp.float32
+        )
+        scale = jnp.minimum(1.0, tau_l / jnp.maximum(norms, eps))
+        center = center + jnp.mean(
+            dev * scale[:, None].astype(dev.dtype), axis=0
+        )
+    b = _uniform(n)
+    b["selected"] = scale
+    b["score"] = norms
+    b["tau"] = tau_l
+    b["clip_frac"] = jnp.mean((scale < 1.0).astype(jnp.float32))
+    return b
+
+
+def _tap_median(stack, f, params):
+    from ..aggregators._common import coordinate_median
+
+    med = coordinate_median(stack)
+    member = (stack == med[None, :]) & jnp.isfinite(stack)
+    selected, share = _coordinate_share(stack, member)
+    b = _uniform(stack.shape[0])
+    b["selected"] = selected
+    b["score"] = share
+    return b
+
+
+def _tap_tmean(stack, f, params):
+    n = stack.shape[0]
+    s = jnp.sort(stack.astype(jnp.float32), axis=0)  # NaN sorts last
+    lo, hi = s[f], s[n - f - 1]
+    member = (
+        (stack >= lo[None, :]) & (stack <= hi[None, :])
+        & jnp.isfinite(stack)
+    )
+    selected, share = _coordinate_share(stack, member)
+    b = _uniform(n)
+    b["selected"] = selected
+    b["score"] = share
+    return b
+
+
+_TAP_FNS = {
+    "krum": _tap_krum,
+    "brute": _tap_brute,
+    "bulyan": _tap_bulyan,
+    "aksel": _tap_aksel,
+    "median": _tap_median,
+    "tmean": _tap_tmean,
+}
+
+
+def compute_flat(gar_name, stack, f, key=None, params=None, center=None):
+    """TapBundle over the rows of the POISONED flat stack the GAR saw.
+
+    ``stack`` is (q, d) in quorum-row order; use ``scatter`` to map a
+    subset-quorum bundle back to the n logical ranks. ``center`` threads
+    a stateful rule's carried v_0 (cclip) so the tap's radii match the
+    rule's actual iteration. Unknown / selection-free rules (average,
+    condense, native-*) report the uniform fallback bundle: everyone
+    observed, everyone selected, zero scores.
+    """
+    params = dict(params or {})
+    base = gar_name.split("native-")[-1]
+    if base == "cclip":
+        return _tap_cclip(stack, f, params, center)
+    fn = _TAP_FNS.get(base)
+    if fn is None:
+        return _uniform(stack.shape[0])
+    return fn(stack, f, params)
+
+
+def scatter(bundle_q, sel, n):
+    """Map a (q,)-rank TapBundle back to the n logical ranks.
+
+    Ranks outside ``sel`` were never observed this step: observed = 0 and
+    selected = 0 there (the hub counts exclusions only among observed
+    ranks, so unobserved != suspicious)."""
+    out = zeros(n)
+    for k in ("observed", "selected", "score"):
+        out[k] = out[k].at[sel].set(bundle_q[k])
+    out["tau"] = bundle_q["tau"]
+    out["clip_frac"] = bundle_q["clip_frac"]
+    return out
+
+
+def mean_bundles(bundles):
+    """Average a leading observer axis away: (m, n) leaves -> (n,).
+
+    The multi-observer topologies (LEARN per-node subsets, ByzSGD per-PS
+    subsets) produce one bundle per observer; the exported tap is the
+    observer MEAN — ``observed`` becomes the fraction of observers whose
+    quorum contained the rank, ``selected`` the mean influence across the
+    observers that saw it."""
+    return jax.tree.map(lambda l: jnp.mean(l, axis=0), bundles)

@@ -43,6 +43,22 @@ __all__ = ["PeerExchange"]
 _HDR = struct.Struct("!IQQ")
 _SLOT = struct.Struct("!Q")
 
+
+def _emit_wait(step, q, arrived, wait_s, timed_out=False):
+    """Report one wait-n-f quorum wait to the telemetry plane.
+
+    Goes through the process-global hook (telemetry.hub.emit_event), a
+    no-op when no MetricsHub is installed — un-telemetered deployments
+    pay one cached-import dict lookup per collect. These events are the
+    host-side latency ground truth the on-mesh seeded-subset emulation
+    has no access to (docs/TELEMETRY.md)."""
+    from ..telemetry import hub as _tele_hub
+
+    _tele_hub.emit_event(
+        "exchange_wait", step=int(step), q=int(q), arrived=int(arrived),
+        wait_s=round(float(wait_s), 6), timed_out=bool(timed_out),
+    )
+
 # Slot frame with this step value is the close sentinel: it wakes every
 # reader blocked in the native register so close() can join them BEFORE
 # freeing the buffer — freeing with a blocked waiter inside
@@ -341,15 +357,21 @@ class PeerExchange:
             # waited slots are accounted for — a timed-out straggler must
             # not mask a still-pending success. The grace on the final
             # acquires covers waiters oversleeping one unarmed 1 s chunk.
-            deadline_box[0] = time.monotonic() + timeout_ms / 1000.0
+            t0 = time.monotonic()
+            deadline_box[0] = t0 + timeout_ms / 1000.0
             hard = deadline_box[0] + 2.0
             for _ in range(len(peers)):
                 if not sem.acquire(timeout=max(hard - time.monotonic(), 0.1)):
                     break
                 if len(results) >= q:
+                    _emit_wait(step, q, len(results), time.monotonic() - t0)
                     return dict(results)
             if len(results) >= q:
+                _emit_wait(step, q, len(results), time.monotonic() - t0)
                 return dict(results)
+            _emit_wait(
+                step, q, len(results), time.monotonic() - t0, timed_out=True
+            )
             raise TimeoutError(
                 f"only {len(results)}/{q} peers reached step {step} "
                 f"within {timeout_ms} ms"
