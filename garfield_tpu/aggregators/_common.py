@@ -51,6 +51,15 @@ def distances_from_gram(gram, *, exclude_self=True):
     guard (krum.py:46-48). The diagonal is +inf when exclude_self (so
     "k smallest" never counts the self-distance), else 0.
     """
+    # Per-pair SYMMETRIC distances, like the reference's (it computes each
+    # unordered pair once and reads it for both directions): XLA's matmul
+    # may accumulate gram[i, j] and gram[j, i] in different orders, and
+    # the resulting 1-ulp asymmetry breaks STRUCTURAL score ties the
+    # wrong way — e.g. Bulyan/Krum at m=1, where the two endpoints of the
+    # globally-closest pair tie exactly and the stable lowest-index
+    # tie-break must decide (caught by the paper-transcribed brute-force
+    # oracle in tests/test_reference_parity.py).
+    gram = 0.5 * (gram + gram.T)
     sq = jnp.diagonal(gram)
     d2 = sq[:, None] + sq[None, :] - 2.0 * gram
     dist = jnp.sqrt(jnp.maximum(d2, 0.0))

@@ -8,338 +8,360 @@ while both do identical FLOPs. vmap closes the op count but loses more to
 5-D relayouts and grouped-conv weight gradients (12.9 ms; unrolling the
 grouped dw inside vmap measured WORSE, 14.0 — r5 probe).
 
-The structural fix implemented here: per-slot gradients only *differ* from
-the fused computation in the parameter-cotangent contractions. Everything
-else — the forward, the activation cotangents (dx), every elementwise op —
-is identical arithmetic for "n workers of batch b" and "one batch n*b".
-So run the model ONCE on the flat (n*b) batch and make ONLY the parameter
+The structural fix: per-slot gradients only *differ* from the fused
+computation in the parameter-cotangent contractions. Everything else —
+the forward, the activation cotangents (dx), every elementwise op — is
+identical arithmetic for "n workers of batch b" and "one batch n*b". So
+run the model ONCE on the flat (n*b) batch and make ONLY the parameter
 gradients slot-resolved:
 
   - every parameter enters the forward STACKED to (slots, ...) — the jax
     autodiff cotangent of a stacked parameter IS the per-slot gradient;
-  - convolutions go through ``slot_conv`` (jax.custom_vjp): primal and dx
-    use ``w[0]`` (all slot rows are equal by construction) at the fused
-    n*b batch; the dw rule computes n per-slot conv weight gradients — the
-    unrolled formulation the chip prefers (a both-batched grouped conv
-    measured 2.9x slower at the primitive level, PERF.md r3);
+  - convolutions go through ``slotlayers.slot_conv`` (jax.custom_vjp):
+    primal and dx use ``w[0]`` (all slot rows are equal by construction)
+    at the fused n*b batch; the dw rule computes n per-slot conv weight
+    gradients (grouped-transpose default, see slotlayers);
   - dense layers become slot-batched matmuls ('sbf,sfo->sbo'), which the
-    MXU handles natively — autodiff's dk ('sbf,sbo->sfo') is a batched
-    matmul too, no custom rule needed;
-  - BatchNorm computes per-slot statistics by a (slots, b, ...) reshaped
-    reduction (a view, not a relayout: the 5-D tensor only feeds the
-    reduce; the normalize stays on the flat 4-D batch with the per-slot
-    stats broadcast back via ``_slot_expand``) — matching the per-worker
-    BN semantics of the unroll path exactly;
-  - scale/bias/bias-like parameters use ``_slot_expand`` (broadcast +
-    reshape), whose autodiff transpose is a per-slot segment sum.
+    MXU handles natively;
+  - BatchNorm computes per-slot statistics over the flat batch
+    (``slotlayers.bn_train``: one-hot slot matmul or sorted segment sum,
+    per ``GARFIELD_SLOTFUSED_BN``) — matching the per-worker BN semantics
+    of the unroll path exactly;
+  - scale/bias/bias-like parameters broadcast via ``slot_expand``, whose
+    autodiff transpose is a per-slot segment reduction.
 
-The result is per-slot gradients equal to the unroll path's (asserted in
-tests/test_slotfused.py — exactly for cifarnet, to deep-net f32
-reassociation tolerance for the BN families) at close to fused cost.
+The result is per-slot gradients equal to the unroll path's (asserted
+per-leaf in tests/test_slotfused.py — exactly for cifarnet, to deep-net
+f32 reassociation tolerance for the BN families) at close to fused cost.
 
-These are functional TWINS of the flax zoo modules (resnet.py / nets.py's
-Cifarnet): they consume the exact flax param/batch_stats trees by name, so
-``core.TrainState``, checkpoints and eval keep using the flax module while
-only the gradient phase routes through the twin. Twins exist for the
-model families where the win matters and the semantics are deterministic
-(no dropout — a twin cannot replicate flax's internal rng-path folding,
-so dropout models keep the unroll); ``build_slot_grad_fn`` returns None
-for everything else and callers fall back to ``core.per_slot_grads``.
+r5 proved the formulation on two hand-written monolithic forwards
+(ResNet, Cifarnet — a 407-LoC twin covering 2 families, VERDICT r5 weak
+#3); this round factors the layer machinery into
+``models/slotlayers.py`` and expresses each twin as a thin GRAPH
+ASSEMBLY over those primitives, registered in ``SLOTFUSED_MODELS``.
+Covered families (all the dropout-free zoo members with a measured win):
+
+  ResNet (BasicBlock + Bottleneck) · Cifarnet · VGG (11/13/16/19) ·
+  GoogLeNet/Inception-v1 · MobileNet · MobileNetV2 · DenseNet-BC
+
+The twins are functional TWINS of the flax zoo modules: they consume the
+exact flax param/batch_stats trees by name (flax ``nn.compact``
+auto-naming — ``Conv_i`` / ``BatchNorm_i`` in creation order, submodules
+``ClassName_i``), so ``core.TrainState``, checkpoints and eval keep using
+the flax module while only the gradient phase routes through the twin.
+Dropout models (Net/CNNet) stay unregistered — a twin cannot replicate
+flax's internal rng-path folding, so equality would be unverifiable;
+``build_slot_grad_fn`` returns None and callers fall back to
+``core.per_slot_grads``. Topologies resolve twins through
+``core.resolve_slot_grad_fn``, so a family added to the registry reaches
+aggregathor, LEARN and ByzSGD with no per-topology change (LEARN's
+per-node params still gate it off — see ``resolve_slot_grad_fn``).
 
 Reference anchor: this whole module replaces the per-worker backward pass
 of Aggregathor/worker.py:89-91 (one process per worker on its own GPU);
 folding n workers onto one chip has no reference counterpart.
 """
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax import lax
 
-__all__ = ["build_slot_grad_fn", "slot_conv"]
+from . import slotlayers as sl
+from .slotlayers import SlotCtx, slot_conv  # re-export (back-compat)
 
-_DN = ("NHWC", "HWIO", "NHWC")
-
-
-def _conv(x, w, stride, padding):
-    return lax.conv_general_dilated(
-        x, w, window_strides=stride, padding=padding, dimension_numbers=_DN
-    )
+__all__ = ["build_slot_grad_fn", "slot_conv", "SLOTFUSED_MODELS"]
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def slot_conv(x, w_st, stride, padding, slots):
-    """Convolution over the flat (slots*b) batch with a STACKED kernel.
+# --------------------------------------------------------------------------
+# Shared micro-assemblies
+# --------------------------------------------------------------------------
 
-    ``w_st`` is (slots, kh, kw, ci, co) with all slot rows equal (a
-    broadcast of the shared kernel); the primal and dx use ``w_st[0]`` at
-    the fused batch, and the custom vjp returns the PER-SLOT weight
-    gradients as ``w_st``'s cotangent — the only place worker-resolved
-    arithmetic is actually required.
-    """
-    return _conv(x, w_st[0], stride, padding)
+def _bn(ctx, h, p, s, name, new, relu=True):
+    """BatchNorm_<name> (+ ReLU), recording the slot-stacked new stats."""
+    y, ns = sl.bn_train(ctx, h, p[name], s[name])
+    new[name] = ns
+    return sl.relu(y) if relu else y
 
 
-def _slot_conv_fwd(x, w_st, stride, padding, slots):
-    return _conv(x, w_st[0], stride, padding), (x, w_st[0])
-
-
-import os as _os
-
-# dw formulation: "grouped" = ONE batch-grouped conv producing all slot
-# kernels (no sliced operands, no stack); "unroll" = n per-slot convs +
-# stack (traced 3.0 ms/step of operand copies + 1.6 ms of stack DUS at
-# n=8 ResNet-18 — kept as the A/B escape hatch).
-DW_MODE = _os.environ.get("GARFIELD_SLOTFUSED_DW", "grouped")
-
-
-def _slot_conv_bwd(stride, padding, slots, res, dy):
-    x, w0 = res
-    # dx: one fused transposed conv over the whole n*b batch.
-    dx = jax.linear_transpose(lambda x_: _conv(x_, w0, stride, padding), x)(
-        dy
-    )[0]
-    nb = x.shape[0] // slots
-    xs = x.reshape(slots, nb, *x.shape[1:])
-    dys = dy.reshape(slots, nb, *dy.shape[1:])
-    if DW_MODE == "grouped":
-        # ONE grouped conv via the transpose of the slot-vmapped conv: the
-        # (slots, nb) reshape is a view of the flat activations, so no
-        # per-slot operand copies and the (slots, ...) result needs no
-        # stacking DUS.
-        def vconv(w_st_):
-            return jax.vmap(
-                lambda xi, wi: _conv(xi, wi, stride, padding)
-            )(xs, w_st_)
-
-        w_like = jnp.broadcast_to(w0[None], (slots,) + w0.shape)
-        dw_st = jax.linear_transpose(vconv, w_like)(dys)[0]
-        return dx, dw_st
-    dws = [
-        jax.linear_transpose(
-            lambda w_: _conv(xs[i], w_, stride, padding), w0
-        )(dys[i])[0]
-        for i in range(slots)
-    ]
-    return dx, jnp.stack(dws)
-
-
-slot_conv.defvjp(_slot_conv_fwd, _slot_conv_bwd)
-
-
-def _slot_matrix(slots, nb, dtype=jnp.float32):
-    """Constant (slots, slots*nb) slot-membership one-hot matrix.
-
-    Per-slot segment reductions over the flat batch are expressed as this
-    tiny matmul instead of a (slots, nb, ...) reshaped reduce: XLA lowers
-    the grouped reduce over the MAJOR dim through transposing copies
-    (traced 1.4 ms/step at ResNet-18 n=8), while `S @ (per-example
-    reduction)` stays in natural layouts — and its autodiff transpose,
-    `S.T @ _`, is the equally clean per-slot broadcast."""
-    return jnp.repeat(jnp.eye(slots, dtype=dtype), nb, axis=1)
-
-
-def _slot_expand(v_st, nb, spatial_dims):
-    """(slots, C) per-slot vector -> flat per-example (slots*nb, 1..1, C).
-
-    The S.T matmul twin of the stats reduction: its autodiff transpose is
-    (spatial reduce -> S @ _), so the BN backward's per-slot segment sums
-    take the same copy-free route as the forward stats (a broadcast+reshape
-    formulation transposes to the 5-D grouped reduce this module avoids).
-    """
-    n = v_st.shape[0]
-    S = _slot_matrix(n, nb, dtype=v_st.dtype)
-    flat = S.T @ v_st  # (slots*nb, C)
-    return flat.reshape(
-        (flat.shape[0],) + (1,) * spatial_dims + (flat.shape[-1],)
-    )
-
-
-def _slot_bn_train(x, p_st, stats, slots, dtype, momentum=0.9, eps=1e-5):
-    """Per-slot BatchNorm (train mode), flax-numerics-compatible.
-
-    Statistics are computed in f32 over each slot's (b, H, W) block via a
-    reshaped reduction (flax nn.BatchNorm computes f32 stats with the fast
-    mean-of-squares variance); the normalize runs on the FLAT batch in the
-    compute dtype with the per-slot stats expanded back. Returns
-    ``(y, {"mean": (slots, C), "var": (slots, C)})`` where the new running
-    stats follow flax's ``m*old + (1-m)*batch`` per slot — the per-worker
-    semantics the unroll path produces.
-    """
-    nb = x.shape[0] // slots
-    # Per-slot stats as (spatial reduce -> (n*b, C)) then a tiny one-hot
-    # matmul — see _slot_matrix for why not a 5-D reshaped reduce.
-    xf = x.astype(jnp.float32)
-    spatial = tuple(range(1, xf.ndim - 1))
-    denom = 1.0 / (nb * int(np.prod([x.shape[a] for a in spatial])))
-    e1 = jnp.sum(xf, axis=spatial)          # (slots*nb, C)
-    e2 = jnp.sum(xf * xf, axis=spatial)     # (slots*nb, C)
-    S = _slot_matrix(slots, nb)
-    mean = (S @ e1) * denom                 # (slots, C)
-    var = (S @ e2) * denom - mean * mean
-    new_stats = {
-        "mean": momentum * stats["mean"][None] + (1.0 - momentum) * mean,
-        "var": momentum * stats["var"][None] + (1.0 - momentum) * var,
-    }
-    new_stats = jax.tree.map(jax.lax.stop_gradient, new_stats)
-    sd = x.ndim - 2
-    # Exactly flax _normalize's association — y = (x - mean) * (rsqrt(var
-    # + eps) * scale) + bias — so the twin's float rounding tracks the flax
-    # path as closely as the fused batch allows (a reassociated scale/shift
-    # form measured ~1e-3 relative after 20 layers of amplification).
-    # Stats stay f32 (flax _compute_stats); the elementwise normalize runs
-    # in the COMPUTE dtype like flax _normalize — an f32 normalize would
-    # double the HBM traffic of every BN under the bf16 pipeline.
-    mul = (jax.lax.rsqrt(var + eps)
-           * p_st["scale"].astype(jnp.float32)).astype(dtype)
-    y = (
-        (x.astype(dtype) - _slot_expand(mean.astype(dtype), nb, sd))
-        * _slot_expand(mul, nb, sd)
-        + _slot_expand(p_st["bias"].astype(dtype), nb, sd)
-    )
-    return y, new_stats
-
-
-def _slot_dense(x2, p_st, slots, dtype):
-    """(slots*b, F) @ per-slot kernel -> (slots, b, O) via a slot-batched
-    matmul; autodiff's dk is a slot-batched matmul too (MXU-native)."""
-    nb = x2.shape[0] // slots
-    x3 = x2.reshape(slots, nb, -1).astype(dtype)
-    y = jnp.einsum("sbf,sfo->sbo", x3, p_st["kernel"].astype(dtype))
-    if "bias" in p_st:
-        y = y + p_st["bias"].astype(dtype)[:, None, :]
-    return y
-
-
-def _relu(x):
-    return jax.nn.relu(x)
-
-
-def _max_pool_flat(x, window=2):
-    return lax.reduce_window(
-        x, -jnp.inf, lax.max,
-        (1, window, window, 1), (1, window, window, 1), "VALID",
-    )
+def _cbr(ctx, h, p, s, new, i, stride=1, groups=1, relu=True):
+    """conv(Conv_i) -> BN(BatchNorm_i) [-> relu], padding derived from the
+    kernel shape (the zoo's convention: k//2 'torch-like' padding; the
+    stacked kernel is (slots, kh, kw, ci, co))."""
+    pad = p[f"Conv_{i}"]["kernel"].shape[1] // 2
+    h = sl.conv(ctx, h, p[f"Conv_{i}"], stride, pad, groups)
+    return _bn(ctx, h, p, s, f"BatchNorm_{i}", new, relu=relu)
 
 
 # --------------------------------------------------------------------------
 # ResNet twin (models/resnet.py: BasicBlock and Bottleneck stacks)
 # --------------------------------------------------------------------------
 
-def _bn_relu(h, p, s, name, new, slots, dtype, relu=True):
-    y, ns = _slot_bn_train(h, p[name], s[name], slots, dtype)
-    new[name] = ns
-    return _relu(y) if relu else y
-
-
-def _basic_block(h, p, s, new, features, stride, slots, dtype):
-    out = slot_conv(
-        h, p["Conv_0"]["kernel"].astype(dtype),
-        (stride, stride), ((1, 1), (1, 1)), slots,
-    )
-    out = _bn_relu(out, p, s, "BatchNorm_0", new, slots, dtype)
-    out = slot_conv(
-        out, p["Conv_1"]["kernel"].astype(dtype),
-        (1, 1), ((1, 1), (1, 1)), slots,
-    )
-    out = _bn_relu(out, p, s, "BatchNorm_1", new, slots, dtype, relu=False)
+def _basic_block(ctx, h, p, s, new, features, stride):
+    out = _cbr(ctx, h, p, s, new, 0, stride=stride)
+    out = _cbr(ctx, out, p, s, new, 1, relu=False)
     if stride != 1 or h.shape[-1] != features:
-        h = slot_conv(
-            h, p["Conv_2"]["kernel"].astype(dtype),
-            (stride, stride), ((0, 0), (0, 0)), slots,
-        )
-        h = _bn_relu(h, p, s, "BatchNorm_2", new, slots, dtype, relu=False)
-    return _relu(out + h)
+        h = _cbr(ctx, h, p, s, new, 2, stride=stride, relu=False)
+    return sl.relu(out + h)
 
 
-def _bottleneck(h, p, s, new, features, stride, slots, dtype):
-    out = slot_conv(
-        h, p["Conv_0"]["kernel"].astype(dtype),
-        (1, 1), ((0, 0), (0, 0)), slots,
-    )
-    out = _bn_relu(out, p, s, "BatchNorm_0", new, slots, dtype)
-    out = slot_conv(
-        out, p["Conv_1"]["kernel"].astype(dtype),
-        (stride, stride), ((1, 1), (1, 1)), slots,
-    )
-    out = _bn_relu(out, p, s, "BatchNorm_1", new, slots, dtype)
-    out = slot_conv(
-        out, p["Conv_2"]["kernel"].astype(dtype),
-        (1, 1), ((0, 0), (0, 0)), slots,
-    )
-    out = _bn_relu(out, p, s, "BatchNorm_2", new, slots, dtype, relu=False)
+def _bottleneck(ctx, h, p, s, new, features, stride):
+    out = _cbr(ctx, h, p, s, new, 0)
+    out = _cbr(ctx, out, p, s, new, 1, stride=stride)
+    out = _cbr(ctx, out, p, s, new, 2, relu=False)
     if stride != 1 or h.shape[-1] != features * 4:
-        h = slot_conv(
-            h, p["Conv_3"]["kernel"].astype(dtype),
-            (stride, stride), ((0, 0), (0, 0)), slots,
+        h = _cbr(ctx, h, p, s, new, 3, stride=stride, relu=False)
+    return sl.relu(out + h)
+
+
+def _resnet_twin(module):
+    from . import resnet
+
+    if module.block is resnet.BasicBlock:
+        block_fn, kind = _basic_block, "BasicBlock"
+    elif module.block is resnet.Bottleneck:
+        block_fn, kind = _bottleneck, "Bottleneck"
+    else:
+        return None
+    stage_sizes = tuple(module.stage_sizes)
+
+    def forward(ctx, p_st, stats, x):
+        new = {}
+        h = _cbr(ctx, x.astype(ctx.dtype), p_st, stats, new, 0)
+        idx = 0
+        for stage, nblocks in enumerate(stage_sizes):
+            for i in range(nblocks):
+                stride = 2 if stage > 0 and i == 0 else 1
+                name = f"{kind}_{idx}"
+                bnew = {}
+                h = block_fn(
+                    ctx, h, p_st[name], stats[name], bnew,
+                    64 * 2 ** stage, stride,
+                )
+                new[name] = bnew
+                idx += 1
+        h = sl.global_avg_pool(h)
+        return sl.dense(ctx, h, p_st["Dense_0"]), new
+
+    return forward
+
+
+# --------------------------------------------------------------------------
+# Cifarnet twin (models/nets.py:40-57 — biased convs + dense head, no BN)
+# --------------------------------------------------------------------------
+
+def _cifarnet_twin(module):
+    def forward(ctx, p_st, stats, x):
+        del stats
+        h = sl.max_pool(
+            sl.relu(sl.conv(ctx, x.astype(ctx.dtype), p_st["Conv_0"], 1, 0)),
+            2,
         )
-        h = _bn_relu(h, p, s, "BatchNorm_3", new, slots, dtype, relu=False)
-    return _relu(out + h)
+        h = sl.max_pool(sl.relu(sl.conv(ctx, h, p_st["Conv_1"], 1, 0)), 2)
+
+        def dense(h, name, relu=True):
+            y = sl.dense(ctx, h.reshape(ctx.slots * ctx.nb, -1), p_st[name])
+            return sl.relu(y) if relu else y
+
+        h = dense(h, "Dense_0")
+        h = dense(h, "Dense_1")
+        return dense(h, "Dense_2", relu=False), {}
+
+    return forward
 
 
-def _resnet_forward(p_st, stats, x, slots, dtype, stage_sizes, block_kind):
-    """Flat-batch forward of models/resnet.py's ResNet, stacked params.
+# --------------------------------------------------------------------------
+# VGG twin (models/vgg.py: conv+BN+ReLU stacks from the cfg table)
+# --------------------------------------------------------------------------
 
-    Returns ``(logits (slots, b, classes), new_batch_stats)`` with the
-    flax module's exact naming so the caller's trees interoperate.
-    """
-    new = {}
-    h = slot_conv(
-        x.astype(dtype), p_st["Conv_0"]["kernel"].astype(dtype),
-        (1, 1), ((1, 1), (1, 1)), slots,
-    )
-    h = _bn_relu(h, p_st, stats, "BatchNorm_0", new, slots, dtype)
-    block_fn = _basic_block if block_kind == "basic" else _bottleneck
-    idx = 0
-    for stage, nblocks in enumerate(stage_sizes):
-        for i in range(nblocks):
-            stride = 2 if stage > 0 and i == 0 else 1
-            name = (
-                f"BasicBlock_{idx}" if block_kind == "basic"
-                else f"Bottleneck_{idx}"
-            )
+def _vgg_twin(module):
+    from . import vgg
+
+    layer_cfg = tuple(vgg.cfg[module.name_cfg])
+
+    def forward(ctx, p_st, stats, x):
+        new = {}
+        h = x.astype(ctx.dtype)
+        ci = 0
+        for v in layer_cfg:
+            if v == "M":
+                h = sl.max_pool(h, 2)
+            else:
+                h = _cbr(ctx, h, p_st, stats, new, ci)
+                ci += 1
+        h = h.reshape(h.shape[0], -1)
+        return sl.dense(ctx, h, p_st["Dense_0"]), new
+
+    return forward
+
+
+# --------------------------------------------------------------------------
+# GoogLeNet / Inception-v1 twin (models/googlenet.py)
+# --------------------------------------------------------------------------
+
+def _inception_block(ctx, h, p, s, new):
+    """Inception submodule: four branches, Conv_i/BatchNorm_i in flax
+    creation order (b1: 0; b2: 1-2; b3: 3-5; b4: 6), channel concat."""
+    b1 = _cbr(ctx, h, p, s, new, 0)
+    b2 = _cbr(ctx, _cbr(ctx, h, p, s, new, 1), p, s, new, 2)
+    b3 = _cbr(ctx, _cbr(ctx, _cbr(ctx, h, p, s, new, 3), p, s, new, 4),
+              p, s, new, 5)
+    b4 = _cbr(ctx, sl.max_pool(h, 3, 1, padding=1), p, s, new, 6)
+    return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+def _googlenet_twin(module):
+    def forward(ctx, p_st, stats, x):
+        new = {}
+        h = _cbr(ctx, x.astype(ctx.dtype), p_st, stats, new, 0)
+        for i in range(9):
+            name = f"Inception_{i}"
             bnew = {}
-            h = block_fn(
-                h, p_st[name], stats[name], bnew,
-                64 * 2 ** stage, stride, slots, dtype,
+            h = _inception_block(ctx, h, p_st[name], stats[name], bnew)
+            new[name] = bnew
+            if i in (1, 6):  # max_pool(3, 2, pad 1) after b3/e4 stacks
+                h = sl.max_pool(h, 3, 2, padding=1)
+        h = sl.global_avg_pool(h)
+        return sl.dense(ctx, h, p_st["Dense_0"]), new
+
+    return forward
+
+
+# --------------------------------------------------------------------------
+# MobileNet v1 twin (models/mobilenet.py: depthwise-separable stacks)
+# --------------------------------------------------------------------------
+
+def _mobilenet_twin(module):
+    from . import mobilenet
+
+    block_cfg = tuple(
+        (v, 1) if isinstance(v, int) else v for v in mobilenet.cfg
+    )
+
+    def forward(ctx, p_st, stats, x):
+        new = {}
+        h = _cbr(ctx, x.astype(ctx.dtype), p_st, stats, new, 0)
+        for i, (_out, stride) in enumerate(block_cfg):
+            name = f"Block_{i}"
+            bnew = {}
+            p, s = p_st[name], stats[name]
+            # depthwise 3x3 (groups = in_planes), then pointwise 1x1
+            h = _cbr(ctx, h, p, s, bnew, 0, stride=stride,
+                     groups=h.shape[-1])
+            h = _cbr(ctx, h, p, s, bnew, 1)
+            new[name] = bnew
+        h = sl.global_avg_pool(h)
+        return sl.dense(ctx, h, p_st["Dense_0"]), new
+
+    return forward
+
+
+# --------------------------------------------------------------------------
+# MobileNetV2 twin (models/mobilenetv2.py: inverted residual blocks)
+# --------------------------------------------------------------------------
+
+def _inverted_residual(ctx, h, p, s, new, stride):
+    out = _cbr(ctx, h, p, s, new, 0)                        # expand 1x1
+    out = _cbr(ctx, out, p, s, new, 1, stride=stride,
+               groups=out.shape[-1])                        # depthwise 3x3
+    out = _cbr(ctx, out, p, s, new, 2, relu=False)          # project 1x1
+    if stride == 1:
+        if "Conv_3" in p:                                   # channel-match
+            h = _cbr(ctx, h, p, s, new, 3, relu=False)
+        out = out + h
+    return out
+
+
+def _mobilenetv2_twin(module):
+    from . import mobilenetv2
+
+    strides = []
+    for _exp, _out, num_blocks, stride in mobilenetv2.cfg:
+        strides += [stride] + [1] * (num_blocks - 1)
+
+    def forward(ctx, p_st, stats, x):
+        new = {}
+        h = _cbr(ctx, x.astype(ctx.dtype), p_st, stats, new, 0)
+        for i, stride in enumerate(strides):
+            name = f"InvertedResidual_{i}"
+            bnew = {}
+            h = _inverted_residual(
+                ctx, h, p_st[name], stats[name], bnew, stride
             )
             new[name] = bnew
-            idx += 1
-    h = h.mean(axis=(1, 2))  # global_avg_pool -> (slots*b, C)
-    logits = _slot_dense(h, p_st["Dense_0"], slots, dtype)
-    return logits, new
+        h = _cbr(ctx, h, p_st, stats, new, 1)               # head 1x1 1280
+        h = sl.global_avg_pool(h)
+        return sl.dense(ctx, h, p_st["Dense_0"]), new
+
+    return forward
 
 
 # --------------------------------------------------------------------------
-# Cifarnet twin (models/nets.py:40-57 — convs + dense head, no BN/dropout)
+# DenseNet-BC twin (models/densenet.py: pre-activation bottlenecks)
 # --------------------------------------------------------------------------
 
-def _cifarnet_forward(p_st, stats, x, slots, dtype):
-    del stats
-    nb = x.shape[0] // slots
+def _dense_bottleneck(ctx, h, p, s, new):
+    out = sl.conv(ctx, _bn(ctx, h, p, s, "BatchNorm_0", new),
+                  p["Conv_0"], 1, 0)
+    out = sl.conv(ctx, _bn(ctx, out, p, s, "BatchNorm_1", new),
+                  p["Conv_1"], 1, 1)
+    return jnp.concatenate([out, h], axis=-1)
 
-    def conv_bias(h, p):
-        h = slot_conv(
-            h, p["kernel"].astype(dtype), (1, 1), ((0, 0), (0, 0)), slots
-        )
-        return h + _slot_expand(p["bias"].astype(dtype), nb, 2)
 
-    def dense(h3, p, relu=True):
-        y = _slot_dense(h3.reshape(slots * nb, -1), p, slots, dtype)
-        return _relu(y) if relu else y
+def _densenet_twin(module):
+    nblocks = tuple(module.nblocks)
 
-    h = _max_pool_flat(_relu(conv_bias(x.astype(dtype), p_st["Conv_0"])))
-    h = _max_pool_flat(_relu(conv_bias(h, p_st["Conv_1"])))
-    h = dense(h.reshape(h.shape[0], -1), p_st["Dense_0"])
-    h = dense(h, p_st["Dense_1"])
-    return dense(h, p_st["Dense_2"], relu=False), {}
+    def forward(ctx, p_st, stats, x):
+        new = {}
+        h = sl.conv(ctx, x.astype(ctx.dtype), p_st["Conv_0"], 1, 1)
+        bi = 0
+        for i, nb in enumerate(nblocks):
+            for _ in range(nb):
+                name = f"Bottleneck_{bi}"
+                bnew = {}
+                h = _dense_bottleneck(ctx, h, p_st[name], stats[name], bnew)
+                new[name] = bnew
+                bi += 1
+            if i != len(nblocks) - 1:
+                name = f"Transition_{i}"
+                bnew = {}
+                p, s = p_st[name], stats[name]
+                h = sl.conv(ctx, _bn(ctx, h, p, s, "BatchNorm_0", bnew),
+                            p["Conv_0"], 1, 0)
+                h = sl.avg_pool(h, 2)
+                new[name] = bnew
+        h = _bn(ctx, h, p_st, stats, "BatchNorm_0", new)
+        h = sl.global_avg_pool(h)
+        return sl.dense(ctx, h, p_st["Dense_0"]), new
+
+    return forward
 
 
 # --------------------------------------------------------------------------
-# Dispatch
+# Registry + dispatch
 # --------------------------------------------------------------------------
+
+def _registry():
+    from . import densenet, googlenet, mobilenet, mobilenetv2, nets, \
+        resnet, vgg
+
+    return {
+        resnet.ResNet: _resnet_twin,
+        nets.Cifarnet: _cifarnet_twin,
+        vgg.VGG: _vgg_twin,
+        googlenet.GoogLeNet: _googlenet_twin,
+        mobilenet.MobileNet: _mobilenet_twin,
+        mobilenetv2.MobileNetV2: _mobilenetv2_twin,
+        densenet.DenseNet: _densenet_twin,
+    }
+
+
+#: The twin table (flax module class -> builder). A builder takes the
+#: module instance and returns ``forward(ctx, p_st, stats, x_flat) ->
+#: (logits (slots, b, classes), new_batch_stats)`` — or None when this
+#: particular instance has no twin (e.g. an unknown ResNet block class).
+#: Register a new family here (or mutate the dict) and every topology
+#: picks it up through ``core.resolve_slot_grad_fn``.
+SLOTFUSED_MODELS = _registry()
+
 
 def build_slot_grad_fn(module, loss_fn):
     """A drop-in for the vmap/unroll per-slot gradient computation.
@@ -349,31 +371,26 @@ def build_slot_grad_fn(module, loss_fn):
     ``jax.vmap(grad_fn, in_axes=(None, None, 0, 0, 0))`` — stacked grads,
     per-slot losses, per-slot updated batch_stats — or None when the
     module has no twin (callers fall back to ``core.per_slot_grads``).
+    Resolution is by module class against ``SLOTFUSED_MODELS``.
     """
-    from . import nets, resnet
-
-    dtype = getattr(module, "dtype", jnp.float32)
-    if isinstance(module, resnet.ResNet):
-        kind = "basic" if module.block is resnet.BasicBlock else (
-            "bottleneck" if module.block is resnet.Bottleneck else None
-        )
-        if kind is None:
-            return None
-        stage_sizes = tuple(module.stage_sizes)
-
-        def forward(p_st, stats, x_flat, slots):
-            return _resnet_forward(
-                p_st, stats, x_flat, slots, dtype, stage_sizes, kind
-            )
-    elif isinstance(module, nets.Cifarnet):
-        def forward(p_st, stats, x_flat, slots):
-            return _cifarnet_forward(p_st, stats, x_flat, slots, dtype)
-    else:
+    builder = None
+    for cls, b in SLOTFUSED_MODELS.items():
+        if isinstance(module, cls):
+            builder = b
+            break
+    if builder is None:
         return None
+    forward = builder(module)
+    if forward is None:
+        return None
+    dtype = getattr(module, "dtype", jnp.float32)
 
     def slot_grad_fn(params, model_state, x, y, keys):
         del keys  # twins exist only for deterministic (dropout-free) models
         slots, b = x.shape[0], x.shape[1]
+        # Per-trace context: slot geometry + the slot matrix / segment ids
+        # built ONCE and shared by every BN layer of the twin.
+        ctx = SlotCtx(slots, b, dtype)
         x_flat = x.reshape((slots * b,) + x.shape[2:])
         stats = model_state.get("batch_stats", {})
         p_st = jax.tree.map(
@@ -381,7 +398,7 @@ def build_slot_grad_fn(module, loss_fn):
         )
 
         def total_loss(p_st):
-            logits, new_stats = forward(p_st, stats, x_flat, slots)
+            logits, new_stats = forward(ctx, p_st, stats, x_flat)
             losses = jax.vmap(loss_fn)(logits, y)  # (slots,)
             return jnp.sum(losses), (losses, new_stats)
 

@@ -1,0 +1,365 @@
+"""Slot-twin layer library: composable primitives for slot-fused models.
+
+The slot-fused formulation (see ``models/slotfused.py`` for the design
+provenance and measurements) computes per-worker ("per-slot") gradients by
+running the model ONCE on the flat ``(slots * b)`` batch and making only
+the parameter-cotangent contractions slot-resolved. r5 proved the idea on
+two hand-written monolithic forwards (ResNet, Cifarnet); this module
+factors the per-layer machinery out so a twin for a new model family is a
+thin graph description over these primitives (the per-model assemblies and
+the ``SLOTFUSED_MODELS`` registry live in ``slotfused.py``):
+
+  - ``slot_conv``       — custom-vjp convolution: primal and dx run fused
+    on the flat batch with the shared kernel (``w_st[0]``); only the dw
+    rule is slot-resolved. Supports ``feature_group_count`` so the
+    depthwise families (mobilenet/v2) fold too.
+  - ``bn_train``        — per-slot BatchNorm statistics over the flat
+    batch, flax-numerics-compatible (f32 stats, compute-dtype normalize).
+  - ``dense``           — slot-batched matmul head ('sbf,sfo->sbo').
+  - ``bias_add``        — per-slot bias broadcast onto the flat batch.
+  - ``max_pool`` / ``avg_pool`` / ``global_avg_pool`` — plain flat-batch
+    ops (no slot resolution needed; kept here so twins import one module).
+
+Every primitive takes a ``SlotCtx``: the per-trace context holding the
+slot geometry plus the PRECOMPUTED slot-membership machinery — the
+``(slots, slots*nb)`` one-hot matrix and the sorted segment-id vector are
+built once per trace and shared by all ~20 BN layers of a deep twin,
+instead of re-emitted per layer.
+
+Two env knobs select the per-slot reduction formulations for on-chip A/B
+(both read at TRACE time — a change needs a fresh trace, i.e. a new jit or
+an unjitted call):
+
+  - ``GARFIELD_SLOTFUSED_BN=matmul|segsum`` (default matmul): per-slot BN
+    statistics as the one-hot slot matmul ``S @ (spatial reduce)`` (the r5
+    formulation) or as a sorted-segment sum over slot ids
+    (``jax.ops.segment_sum`` with ``indices_are_sorted``). The matmul
+    keeps everything on the MXU; the segment sum avoids materializing the
+    ``(slots, slots*b)`` operand and lowers to an in-order add — which of
+    the two schedules better against the backward's grouped dw convs is a
+    chip question (PERF.md round 7).
+  - ``GARFIELD_SLOTFUSED_DW=grouped|unroll|segsum`` (default grouped):
+    the dw formulation of ``slot_conv``'s backward plus its epilogue.
+    ``grouped`` and ``unroll`` are the r5 modes (one batch-grouped conv
+    vs n per-slot convs + stack); ``segsum`` keeps the grouped dw convs
+    but routes the EPILOGUE — the per-slot bias/BN cotangent reductions,
+    i.e. the transpose of every ``slot_expand`` broadcast — through the
+    same segment machinery (gather forward, sorted segment-sum
+    transpose) instead of the ``S.T`` matmul twin, so the ~20 BN
+    slot-stat reductions of a deep twin stop competing for the MXU
+    against the grouped convs they are scheduled with.
+"""
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = [
+    "SlotCtx",
+    "slot_conv",
+    "conv",
+    "bn_train",
+    "dense",
+    "bias_add",
+    "relu",
+    "max_pool",
+    "avg_pool",
+    "global_avg_pool",
+]
+
+_DN = ("NHWC", "HWIO", "NHWC")
+
+
+def bn_stats_mode():
+    """BN per-slot statistics formulation (read at trace time)."""
+    return os.environ.get("GARFIELD_SLOTFUSED_BN", "matmul")
+
+
+def dw_mode():
+    """slot_conv dw / epilogue formulation (read at trace time)."""
+    return os.environ.get("GARFIELD_SLOTFUSED_DW", "grouped")
+
+
+class SlotCtx:
+    """Per-trace slot geometry + precomputed membership machinery.
+
+    Built once per ``slot_grad_fn`` trace (``slotfused.build_slot_grad_fn``)
+    and threaded through every primitive, so the slot matrix / segment ids
+    exist once in the traced graph no matter how many layers consume them.
+    """
+
+    def __init__(self, slots, nb, dtype):
+        self.slots = int(slots)
+        self.nb = int(nb)
+        self.dtype = dtype
+        self.bn_mode = bn_stats_mode()
+        self.dw = dw_mode()
+        if self.bn_mode not in ("matmul", "segsum"):
+            raise ValueError(
+                f"GARFIELD_SLOTFUSED_BN must be matmul|segsum, "
+                f"got {self.bn_mode!r}"
+            )
+        if self.dw not in ("grouped", "unroll", "segsum"):
+            raise ValueError(
+                f"GARFIELD_SLOTFUSED_DW must be grouped|unroll|segsum, "
+                f"got {self.dw!r}"
+            )
+        # Sorted slot-membership ids (example k of the flat batch belongs
+        # to slot k // nb) — a host constant; jnp ops lift it once.
+        self.seg_ids = np.repeat(np.arange(self.slots), self.nb)
+        self._S = {}
+
+    def slot_matrix(self, dtype):
+        """Constant (slots, slots*nb) one-hot membership matrix, built at
+        most once per dtype per trace.
+
+        Per-slot segment reductions over the flat batch are expressed as
+        this tiny matmul instead of a (slots, nb, ...) reshaped reduce:
+        XLA lowers the grouped reduce over the MAJOR dim through
+        transposing copies (traced 1.4 ms/step at ResNet-18 n=8), while
+        ``S @ (per-example reduction)`` stays in natural layouts — and its
+        autodiff transpose, ``S.T @ _``, is the equally clean per-slot
+        broadcast.
+        """
+        key = jnp.dtype(dtype).name
+        if key not in self._S:
+            self._S[key] = jnp.repeat(
+                jnp.eye(self.slots, dtype=dtype), self.nb, axis=1
+            )
+        return self._S[key]
+
+
+def slot_reduce(ctx, e):
+    """Per-slot segment reduction: (slots*nb, C) f32 -> (slots, C) f32.
+
+    ``matmul`` mode: ``S @ e`` (MXU). ``segsum`` mode: sorted segment sum
+    over the slot ids (no (slots, slots*nb) operand; in-order adds, so the
+    two modes are f32-rounding-equal for equal-length segments summed in
+    index order — equality-pinned in tests/test_slotfused.py).
+    """
+    if ctx.bn_mode == "segsum":
+        return jax.ops.segment_sum(
+            e, ctx.seg_ids, num_segments=ctx.slots, indices_are_sorted=True
+        )
+    return ctx.slot_matrix(e.dtype) @ e
+
+
+def slot_expand(ctx, v_st, spatial_dims):
+    """(slots, C) per-slot vector -> flat per-example (slots*nb, 1..1, C).
+
+    ``grouped``/``unroll`` dw modes: the ``S.T`` matmul twin of the stats
+    reduction — its autodiff transpose is (spatial reduce -> ``S @ _``),
+    the same copy-free route as the forward stats (a broadcast+reshape
+    formulation transposes to the 5-D grouped reduce this library avoids).
+    ``segsum`` dw mode: a row gather over the sorted slot ids, whose
+    transpose is a sorted segment-sum scatter-add — the dw-epilogue
+    formulation (module docstring): per-slot bias/BN cotangents leave the
+    MXU to the grouped dw convs.
+    """
+    if ctx.dw == "segsum":
+        flat = v_st[ctx.seg_ids]  # gather; transpose = sorted segment sum
+    else:
+        flat = ctx.slot_matrix(v_st.dtype).T @ v_st  # (slots*nb, C)
+    return flat.reshape(
+        (flat.shape[0],) + (1,) * spatial_dims + (flat.shape[-1],)
+    )
+
+
+# --------------------------------------------------------------------------
+# Convolution: fused primal/dx, per-slot dw (custom vjp)
+# --------------------------------------------------------------------------
+
+def _conv(x, w, stride, padding, groups):
+    return lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        dimension_numbers=_DN, feature_group_count=groups,
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _slot_conv(x, w_st, stride, padding, slots, groups):
+    return _conv(x, w_st[0], stride, padding, groups)
+
+
+def _slot_conv_fwd(x, w_st, stride, padding, slots, groups):
+    return _conv(x, w_st[0], stride, padding, groups), (x, w_st[0])
+
+
+def _slot_conv_bwd(stride, padding, slots, groups, res, dy):
+    """dx fused over the flat batch; dw slot-resolved.
+
+    dw formulations (``GARFIELD_SLOTFUSED_DW``, read at trace time):
+    ``grouped`` (default) and ``segsum`` run ONE batch-grouped conv via
+    the transpose of the slot-vmapped conv — the (slots, nb) reshape is a
+    view of the flat activations, so no per-slot operand copies and the
+    (slots, ...) result needs no stacking DUS (``segsum`` differs only in
+    the epilogue reductions around the convs — see ``slot_expand``).
+    ``unroll`` is the r5 A/B escape hatch: n per-slot convs + stack
+    (traced 3.0 ms/step of operand copies + 1.6 ms of stack DUS at n=8
+    ResNet-18 — the b=25 slot slices misalign with the (8,128) tile).
+    """
+    x, w0 = res
+    # dx: one fused transposed conv over the whole n*b batch.
+    dx = jax.linear_transpose(
+        lambda x_: _conv(x_, w0, stride, padding, groups), x
+    )(dy)[0]
+    nb = x.shape[0] // slots
+    xs = x.reshape(slots, nb, *x.shape[1:])
+    dys = dy.reshape(slots, nb, *dy.shape[1:])
+    if dw_mode() != "unroll":
+        def vconv(w_st_):
+            return jax.vmap(
+                lambda xi, wi: _conv(xi, wi, stride, padding, groups)
+            )(xs, w_st_)
+
+        w_like = jnp.broadcast_to(w0[None], (slots,) + w0.shape)
+        dw_st = jax.linear_transpose(vconv, w_like)(dys)[0]
+        return dx, dw_st
+    dws = [
+        jax.linear_transpose(
+            lambda w_: _conv(xs[i], w_, stride, padding, groups), w0
+        )(dys[i])[0]
+        for i in range(slots)
+    ]
+    return dx, jnp.stack(dws)
+
+
+_slot_conv.defvjp(_slot_conv_fwd, _slot_conv_bwd)
+
+
+def slot_conv(x, w_st, stride, padding, slots, groups=1):
+    """Convolution over the flat (slots*b) batch with a STACKED kernel.
+
+    ``w_st`` is (slots, kh, kw, ci/groups, co) with all slot rows equal (a
+    broadcast of the shared kernel); the primal and dx use ``w_st[0]`` at
+    the fused batch, and the custom vjp returns the PER-SLOT weight
+    gradients as ``w_st``'s cotangent — the only place worker-resolved
+    arithmetic is actually required. ``groups`` is
+    ``lax.conv_general_dilated``'s ``feature_group_count`` (depthwise
+    convs pass ``groups == in_channels``).
+    """
+    return _slot_conv(x, w_st, stride, padding, slots, groups)
+
+
+def conv(ctx, x, p_st, stride, padding, groups=1):
+    """Layer-level conv: stacked kernel + optional per-slot bias.
+
+    ``p_st`` is the stacked flax param dict (``kernel`` and optionally
+    ``bias``); strides/padding accept ints like ``models/_layers.conv``.
+    """
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    y = slot_conv(
+        x, p_st["kernel"].astype(ctx.dtype), stride, padding, ctx.slots,
+        groups,
+    )
+    if "bias" in p_st:
+        y = y + slot_expand(ctx, p_st["bias"].astype(ctx.dtype), x.ndim - 2)
+    return y
+
+
+# --------------------------------------------------------------------------
+# BatchNorm (train mode), per-slot statistics
+# --------------------------------------------------------------------------
+
+def bn_train(ctx, x, p_st, stats, momentum=0.9, eps=1e-5):
+    """Per-slot BatchNorm (train mode), flax-numerics-compatible.
+
+    Statistics are computed in f32 over each slot's (b, H, W) block (flax
+    nn.BatchNorm computes f32 stats with the fast mean-of-squares
+    variance) via ``slot_reduce`` — the one-hot slot matmul or the sorted
+    segment sum, per ``GARFIELD_SLOTFUSED_BN``; the normalize runs on the
+    FLAT batch in the compute dtype with the per-slot stats expanded back.
+    Returns ``(y, {"mean": (slots, C), "var": (slots, C)})`` where the new
+    running stats follow flax's ``m*old + (1-m)*batch`` per slot — the
+    per-worker semantics the unroll path produces.
+    """
+    # Stats width follows flax _compute_stats: at least f32, wider if the
+    # activations are wider (f64 under an x64 pipeline — what the tight
+    # structural equality pins in tests/test_slotfused.py run under).
+    xf = x.astype(jnp.promote_types(jnp.float32, x.dtype))
+    spatial = tuple(range(1, xf.ndim - 1))
+    denom = 1.0 / (ctx.nb * int(np.prod([x.shape[a] for a in spatial])))
+    e1 = jnp.sum(xf, axis=spatial)          # (slots*nb, C)
+    e2 = jnp.sum(xf * xf, axis=spatial)     # (slots*nb, C)
+    mean = slot_reduce(ctx, e1) * denom     # (slots, C)
+    var = slot_reduce(ctx, e2) * denom - mean * mean
+    new_stats = {
+        "mean": momentum * stats["mean"][None] + (1.0 - momentum) * mean,
+        "var": momentum * stats["var"][None] + (1.0 - momentum) * var,
+    }
+    new_stats = jax.tree.map(jax.lax.stop_gradient, new_stats)
+    sd = x.ndim - 2
+    # Exactly flax _normalize's association — y = (x - mean) * (rsqrt(var
+    # + eps) * scale) + bias — so the twin's float rounding tracks the flax
+    # path as closely as the fused batch allows (a reassociated scale/shift
+    # form measured ~1e-3 relative after 20 layers of amplification).
+    # Stats stay f32 (flax _compute_stats); the elementwise normalize runs
+    # in the COMPUTE dtype like flax _normalize — an f32 normalize would
+    # double the HBM traffic of every BN under the bf16 pipeline.
+    dtype = ctx.dtype
+    mul = (jax.lax.rsqrt(var + eps)
+           * p_st["scale"].astype(xf.dtype)).astype(dtype)
+    y = (
+        (x.astype(dtype) - slot_expand(ctx, mean.astype(dtype), sd))
+        * slot_expand(ctx, mul, sd)
+        + slot_expand(ctx, p_st["bias"].astype(dtype), sd)
+    )
+    return y, new_stats
+
+
+# --------------------------------------------------------------------------
+# Dense / bias / activations / pooling (flat-batch ops)
+# --------------------------------------------------------------------------
+
+def dense(ctx, x2, p_st):
+    """(slots*b, F) @ per-slot kernel -> (slots, b, O) via a slot-batched
+    matmul; autodiff's dk is a slot-batched matmul too (MXU-native)."""
+    x3 = x2.reshape(ctx.slots, ctx.nb, -1).astype(ctx.dtype)
+    y = jnp.einsum("sbf,sfo->sbo", x3, p_st["kernel"].astype(ctx.dtype))
+    if "bias" in p_st:
+        y = y + p_st["bias"].astype(ctx.dtype)[:, None, :]
+    return y
+
+
+def bias_add(ctx, x, b_st):
+    """Add a (slots, C) per-slot bias onto the flat (slots*b, ..., C)."""
+    return x + slot_expand(ctx, b_st.astype(ctx.dtype), x.ndim - 2)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def max_pool(x, window=2, stride=None, padding=0):
+    """NHWC max pool over the flat batch (int padding like _layers)."""
+    stride = window if stride is None else stride
+    pad = (
+        ((0, 0), (padding, padding), (padding, padding), (0, 0))
+        if isinstance(padding, int) else padding
+    )
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        (1, window, window, 1), (1, stride, stride, 1), pad,
+    )
+
+
+def avg_pool(x, window=2, stride=None):
+    """NHWC average pool (VALID), matching ``_layers.avg_pool``."""
+    stride = window if stride is None else stride
+    summed = lax.reduce_window(
+        x, 0.0, lax.add,
+        (1, window, window, 1), (1, stride, stride, 1), "VALID",
+    )
+    return summed / (window * window)
+
+
+def global_avg_pool(x):
+    """NHWC global average pool -> (N, C)."""
+    return jnp.mean(x, axis=(1, 2))

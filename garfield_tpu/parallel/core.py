@@ -230,18 +230,42 @@ def slot_path_decision(slots, num_iter=None, fused_available=False):
     )
 
 
-def select_slot_path(module, loss_fn, slots, num_iter=None, log_tag=None):
+def resolve_slot_grad_fn(module, loss_fn, slots, shared_params=True):
+    """Resolve the slot-fused gradient twin for a module, or None.
+
+    The single front-end every topology consults (directly or via
+    ``select_slot_path``): it checks the fold geometry (``slots > 1`` —
+    one slot per shard has nothing to fuse), the escape hatch
+    (``GARFIELD_NO_SLOTFUSED``), the parameter-sharing precondition, and
+    the ``models.slotfused.SLOTFUSED_MODELS`` registry — so a model family
+    added to the registry reaches aggregathor, LEARN and ByzSGD with no
+    per-topology change.
+
+    ``shared_params=False`` declares that the slots carry DISTINCT
+    parameter trees (LEARN's per-node models): the twin's fused primal
+    runs the flat batch against ONE shared kernel (``slot_conv`` uses
+    ``w_st[0]``), so it is structurally inapplicable there and this
+    returns None. If a stacked-params twin formulation ever lands, only
+    this gate changes.
+    """
+    if slots <= 1 or not shared_params:
+        return None
+    if _os.environ.get("GARFIELD_NO_SLOTFUSED"):
+        return None
+    from ..models import slotfused
+
+    return slotfused.build_slot_grad_fn(module, loss_fn)
+
+
+def select_slot_path(module, loss_fn, slots, num_iter=None, log_tag=None,
+                     shared_params=True):
     """Shared topology-builder front-end to ``slot_path_decision``.
 
-    Builds the slot-fused twin when eligible (slots fold, model has a twin,
-    GARFIELD_NO_SLOTFUSED unset), logs the decision, and returns
-    ``(fused_fn, force_unroll)`` ready to pass to ``per_slot_grads``.
+    Resolves the slot-fused twin via ``resolve_slot_grad_fn``, logs the
+    decision, and returns ``(fused_fn, force_unroll)`` ready to pass to
+    ``per_slot_grads``.
     """
-    fused_fn = None
-    if slots > 1 and not _os.environ.get("GARFIELD_NO_SLOTFUSED"):
-        from ..models import slotfused
-
-        fused_fn = slotfused.build_slot_grad_fn(module, loss_fn)
+    fused_fn = resolve_slot_grad_fn(module, loss_fn, slots, shared_params)
     path, why = slot_path_decision(slots, num_iter, fused_fn is not None)
     if slots > 1:
         from ..utils import tools
