@@ -1,0 +1,334 @@
+"""Host-plane publish/collect round benchmark + cluster-mode steps/s.
+
+The committed record for the ``apps/cluster.py`` path (VERDICT r5 item 4:
+no step-time number existed for the host plane at all). Two modes:
+
+**Micro** (default): for each (n, d, wire) cell, n localhost OS processes
+— rank 0 in this process, ranks 1..n-1 spawned — run ``--rounds``
+rank-0-paced publish/collect round trips per trial over a REAL
+``PeerExchange`` (TCP frames + the native MRMW register), every frame
+through the typed wire codec (``utils/wire.py``) with eager decode in the
+collect waiter threads (the shipped cluster path; see ``_rank0_rounds``
+for why the pacing is what makes the rounds loss-free on the
+last-writer-wins register). Rank 0 records the median round latency per
+trial and commits the MIN over ``--trials`` (gar_bench's min-over-k:
+co-tenant noise only adds time). ``wire_bytes_per_step`` is the per-node
+DCN fan-out: (n-1) frames of ``wire.frame_nbytes(d, w)`` — the number the
+bf16 codec halves.
+
+**--e2e**: additionally runs the SSMW cluster deployment end-to-end
+(1 PS + ``--e2e_workers`` worker subprocesses, mnist/convnet,
+JAX_PLATFORMS=cpu) once per wire dtype with ``--telemetry``, and derives
+steps/s from the PS's per-step ``step_time_s`` records (median over the
+post-warmup steps — the BASELINE.md cluster-mode row) plus wire
+bytes/step from the summary's wire totals.
+
+  python -m garfield_tpu.apps.benchmarks.exchange_bench \\
+      --ns 2 4 --ds 1000 100000 1000000 --wire f32 bf16 \\
+      --json EXCHBENCH_r01.json --e2e
+"""
+
+import argparse
+import json
+import os
+import socket
+import statistics
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from ...utils import wire
+from ...utils.exchange import PeerExchange
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+)))
+
+
+def _ports(k):
+    socks = [socket.socket() for _ in range(k)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _decode_tf(idx, payload):
+    return wire.decode(payload)
+
+
+def _barrier(ex, n):
+    """Startup barrier: everyone publishes a hello at step 0 and waits
+    for every peer's — the micro rounds must time the exchange, not
+    subprocess startup skew."""
+    ex.publish(0, b"up")
+    for r in range(n):
+        if r != ex.my_index:
+            ex.read_latest(r, 0, timeout_ms=120_000)
+
+
+def _rank0_rounds(ex, n, d, wire_dtype, rounds, trials):
+    """Rank 0 PACES the mesh, SSMW-style: publish the round's frame to
+    every peer, collect every peer's typed response (eager decode in the
+    waiter threads — the shipped cluster path). The pacing is the
+    loss-freedom proof on the last-writer-wins register: a peer publishes
+    round s only after reading rank 0's s, and rank 0 publishes s+1 only
+    after collecting EVERY peer's s — so no round frame can be
+    overwritten before its reader latched it. (A free-running symmetric
+    protocol drops rounds here: two back-to-back writes from a fast peer
+    land before the blocked reader is scheduled, and the register keeps
+    only the newer — the exact race apps/cluster's role pacing closes.)
+    Round latency = encode + fan-out + per-peer read/decode/re-encode/
+    respond + collect + eager decode: two wire hops, the PS step's wire
+    component. Returns the min-over-trials of the per-trial median."""
+    rng = np.random.default_rng(1234)
+    vec = rng.standard_normal(d).astype(np.float32)
+    _barrier(ex, n)
+    step = 1
+    per_trial = []
+    for _ in range(max(1, trials)):
+        lats = []
+        for _ in range(rounds):
+            wait = ex.collect_begin(step, n, timeout_ms=120_000,
+                                    transform=_decode_tf)
+            t0 = time.perf_counter()
+            ex.publish(step, wire.encode(vec, wire_dtype))
+            got = wait()
+            lats.append(time.perf_counter() - t0)
+            assert len(got) == n and not any(
+                isinstance(v, Exception) for v in got.values()
+            )
+            step += 1
+        per_trial.append(statistics.median(lats))
+    return min(per_trial) if per_trial else None
+
+
+def _child_main(args):
+    hosts = args.hosts.split(",")
+    n = len(hosts)
+    ex = PeerExchange(args.child, hosts, connect_retry_ms=120_000)
+    rng = np.random.default_rng(1234 + args.child)
+    vec = rng.standard_normal(args.d).astype(np.float32)
+    try:
+        _barrier(ex, n)
+        for step in range(1, 1 + args.rounds * max(1, args.trials)):
+            got = ex.collect(step, 1, peers=[0], timeout_ms=120_000,
+                             transform=_decode_tf)
+            assert not isinstance(got[0], Exception)
+            ex.publish(step, wire.encode(vec, args.child_wire), to=[0])
+    finally:
+        ex.close()
+
+
+def _spawn_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        _REPO + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH") else _REPO
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep subprocesses off the TPU
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def bench_cell(n, d, wire_dtype, rounds, trials):
+    """One micro cell: spawn ranks 1..n-1, run rank 0 here."""
+    hosts = [f"127.0.0.1:{p}" for p in _ports(n)]
+    env = _spawn_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m",
+             "garfield_tpu.apps.benchmarks.exchange_bench",
+             "--child", str(k), "--hosts", ",".join(hosts),
+             "--d", str(d), "--rounds", str(rounds),
+             "--trials", str(trials), "--child_wire", wire_dtype],
+            env=env,
+        )
+        for k in range(1, n)
+    ]
+    ex = PeerExchange(0, hosts, connect_retry_ms=120_000)
+    try:
+        round_s = _rank0_rounds(ex, n, d, wire_dtype, rounds, trials)
+    finally:
+        ex.close()
+        for p in procs:
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return {
+        "mode": "micro", "n": n, "d": d, "wire": wire_dtype,
+        "round_s": round_s,
+        "wire_bytes_per_step": (n - 1) * wire.frame_nbytes(d, wire_dtype),
+        "rounds": rounds, "trials": trials,
+    }
+
+
+def bench_e2e(wire_dtype, n_w, iters, tmpdir):
+    """End-to-end SSMW cluster run (1 PS + n_w worker subprocesses) at
+    ``wire_dtype``; steps/s from the PS's telemetry step records (median
+    ``step_time_s`` over the post-warmup steps — compile-free, unlike
+    wall_s / steps), wire bytes/step from the summary totals."""
+    from ...utils import multihost
+
+    pp = _ports(1 + n_w)
+    cfg_path = os.path.join(tmpdir, f"cluster_{wire_dtype}.json")
+    multihost.generate_config(
+        cfg_path,
+        ps=[f"127.0.0.1:{pp[0]}"],
+        workers=[f"127.0.0.1:{p}" for p in pp[1:]],
+        task_type="ps", task_index=0,
+    )
+    env = _spawn_env()
+    env["GARFIELD_WIRE_DTYPE"] = wire_dtype
+    env["GARFIELD_SURROGATE_MARGIN"] = "30"
+    env["GARFIELD_SURROGATE_LABEL_NOISE"] = "0"
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    tele_dir = os.path.join(tmpdir, f"tele_{wire_dtype}")
+
+    def launch(role):
+        return subprocess.Popen(
+            [sys.executable, "-m", "garfield_tpu.apps.aggregathor",
+             "--cluster", cfg_path, "--task", role,
+             "--dataset", "mnist", "--model", "convnet", "--batch", "16",
+             "--fw", "1", "--gar", "median", "--num_iter", str(iters),
+             "--acc_freq", "0", "--train_size", "512",
+             "--cluster_timeout_ms", "120000", "--telemetry", tele_dir],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+
+    ps = launch("ps:0")
+    workers = [launch(f"worker:{w}") for w in range(n_w)]
+    try:
+        out, _ = ps.communicate(timeout=600 + 10 * iters)
+        if ps.returncode != 0:
+            raise RuntimeError(f"e2e PS failed:\n{out[-2000:]}")
+        summary = json.loads(
+            [l for l in out.splitlines() if l.startswith("{")][-1]
+        )
+        for w in workers:
+            w.communicate(timeout=120)
+    finally:
+        for p in [ps, *workers]:
+            if p.poll() is None:
+                p.kill()
+    step_times, wire_totals = [], None
+    with open(os.path.join(tele_dir, "cluster-ps.telemetry.jsonl")) as fp:
+        for line in fp:
+            rec = json.loads(line)
+            if rec["kind"] == "step" and rec.get("step_time_s") is not None:
+                step_times.append((rec["step"], rec["step_time_s"]))
+            elif rec["kind"] == "summary":
+                wire_totals = rec.get("wire")
+    # Warmup excluded: the first steps pay grad/update compiles and the
+    # exchange's cold-start connect grace.
+    warm = [t for s, t in step_times if s >= 5]
+    med = statistics.median(warm) if warm else None
+    steps = summary["steps"]
+    return {
+        "mode": "cluster_e2e", "wire": wire_dtype, "workers": n_w,
+        "iters": iters, "steps": steps,
+        "wall_s": round(summary["wall_s"], 3),
+        "step_s_median": None if med is None else round(med, 6),
+        "steps_per_s": None if not med else round(1.0 / med, 3),
+        "wire_bytes_per_step": (
+            None if not (wire_totals and steps) else
+            int((wire_totals["bytes_out"] + wire_totals["bytes_in"])
+                / steps)
+        ),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="host-plane exchange/wire-codec benchmark"
+    )
+    p.add_argument("--ns", nargs="*", type=int, default=[2, 4])
+    p.add_argument("--ds", nargs="*", type=int,
+                   default=[1_000, 100_000, 1_000_000])
+    p.add_argument("--wire", nargs="*", default=list(wire.WIRE_DTYPES),
+                   choices=wire.WIRE_DTYPES)
+    p.add_argument("--rounds", type=int, default=20,
+                   help="publish/collect rounds per trial")
+    p.add_argument("--trials", type=int, default=3,
+                   help="independent trials; the committed value is the "
+                        "min of the per-trial medians (min-over-k)")
+    p.add_argument("--e2e", action="store_true",
+                   help="also run the SSMW cluster deployment end-to-end "
+                        "per wire dtype (the BASELINE.md row)")
+    p.add_argument("--e2e_workers", type=int, default=4)
+    p.add_argument("--e2e_iters", type=int, default=40)
+    p.add_argument("--json", type=str, default=None,
+                   help="dump results (+ the schema-versioned telemetry "
+                        "JSONL twin at the same path with a .jsonl "
+                        "suffix)")
+    # child-process plumbing (internal)
+    p.add_argument("--child", type=int, default=None, help=argparse.SUPPRESS)
+    p.add_argument("--hosts", type=str, default=None, help=argparse.SUPPRESS)
+    p.add_argument("--d", type=int, default=None, help=argparse.SUPPRESS)
+    p.add_argument("--child_wire", type=str, default="f32",
+                   help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+    if args.child is not None:
+        return _child_main(args)
+
+    results = []
+    for n in args.ns:
+        for d in args.ds:
+            for w in args.wire:
+                row = bench_cell(n, d, w, args.rounds, args.trials)
+                results.append(row)
+                rs = row["round_s"]
+                print(
+                    f"n={n} d={d:<9} wire={w:<4} "
+                    f"{'below noise floor' if rs is None else f'{rs * 1e3:9.3f} ms'}"
+                    f"  {row['wire_bytes_per_step']:>12} B/step",
+                    flush=True,
+                )
+    if args.e2e:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            for w in args.wire:
+                row = bench_e2e(w, args.e2e_workers, args.e2e_iters, td)
+                results.append(row)
+                print(
+                    f"e2e wire={w:<4} {row['steps_per_s']} steps/s "
+                    f"({row['wire_bytes_per_step']} wire B/step)",
+                    flush=True,
+                )
+    if args.json:
+        with open(args.json, "w") as fp:
+            json.dump(results, fp, indent=1)
+        from ...telemetry import exporters
+
+        jsonl_path = os.path.splitext(args.json)[0] + ".jsonl"
+        with exporters.JsonlExporter(jsonl_path) as exp:
+            for row in results:
+                if row["mode"] == "micro":
+                    exp.write(exporters.make_record(
+                        "exchange_bench",
+                        n=row["n"], d=row["d"], wire=row["wire"],
+                        round_s=row["round_s"],
+                        wire_bytes_per_step=row["wire_bytes_per_step"],
+                        rounds=row["rounds"], trials=row["trials"],
+                    ))
+                else:
+                    exp.write(exporters.make_record(
+                        "bench",
+                        metric=f"cluster_ssmw_steps_per_s_{row['wire']}",
+                        value=row["steps_per_s"],
+                        unit="steps/s",
+                        wire_bytes_per_step=row["wire_bytes_per_step"],
+                    ))
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

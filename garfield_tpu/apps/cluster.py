@@ -66,7 +66,7 @@ from jax.flatten_util import ravel_pytree
 from ..aggregators import gars
 from ..parallel import core
 from ..telemetry import hub as tele_hooks
-from ..utils import multihost, tools
+from ..utils import multihost, tools, wire
 from ..utils.exchange import PeerExchange
 from . import common
 
@@ -229,6 +229,108 @@ def _robust_stats(rows, f):
     return np.mean(s[t:q - t], axis=0).astype(np.float32)
 
 
+def _eager_h2d():
+    """Whether decoded rows are ``jax.device_put`` from the exchange
+    waiter threads (overlapping H2D staging with the still-open quorum
+    and the local device step). Default on — jax dispatch is thread-safe
+    on the pinned jax/jaxlib; ``GARFIELD_EAGER_H2D=0`` opts out for a
+    backend where it is not."""
+    import os
+
+    return os.environ.get("GARFIELD_EAGER_H2D", "1").lower() not in (
+        "0", "false",
+    )
+
+
+class _WireStats:
+    """Per-role wire-plane accounting for the telemetry plane
+    (docs/TELEMETRY.md): bytes and codec seconds, both directions.
+    Receive-side appends happen on exchange waiter threads —
+    ``list.append`` is GIL-atomic; the sums happen at the per-step
+    ``flush`` on the role's main thread."""
+
+    def __init__(self, who):
+        self.who = who
+        self._out = []
+        self._in = []
+
+    def sent(self, nbytes, encode_s, fanout):
+        self._out.append((int(nbytes) * int(fanout), float(encode_s)))
+
+    def received(self, nbytes, decode_s):
+        self._in.append((int(nbytes), float(decode_s)))
+
+    def flush(self, step):
+        out, self._out = self._out, []
+        rin, self._in = self._in, []
+        if tele_hooks.current() is None:
+            return
+        tele_hooks.emit_event(
+            "wire", who=self.who, step=int(step),
+            bytes_out=sum(b for b, _ in out),
+            bytes_in=sum(b for b, _ in rin),
+            frames_in=len(rin),
+            encode_s=round(sum(t for _, t in out), 6),
+            decode_s=round(sum(t for _, t in rin), 6),
+        )
+
+
+def _encode_frame(parts, stats=None, fanout=1):
+    """The wire codec's single PRODUCER for the cluster driver: encode
+    the concatenation of f32 segments (``[grad || stats]`` /
+    ``[params || stats]``) as one typed frame at the configured
+    ``GARFIELD_WIRE_DTYPE``, accounting bytes x fan-out and encode time
+    for the telemetry plane."""
+    t0 = time.perf_counter()
+    parts = [np.asarray(p, np.float32).reshape(-1) for p in parts]
+    vec = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    frame = wire.encode(vec)
+    if stats is not None:
+        stats.sent(len(frame), time.perf_counter() - t0, fanout)
+    return frame
+
+
+def _frame_transform(split, stats=None, pass_empty=False):
+    """The wire codec's single CONSUMER: the eager per-frame decode hook
+    every cluster role hands to ``collect_begin``/``read_latest_begin``
+    (the four roles used to hand-roll paired ``np.frombuffer`` splits
+    after the quorum closed). Runs on the exchange waiter thread the
+    moment a frame lands: wire-decode (crc + dtype restore), split into
+    ``(primary, stats_segment)``, and stage the primary segment onto the
+    device — overlapping decode + H2D with the other peers' receives and
+    the local device step. A codec reject raises ``wire.WireError``
+    (stored by the exchange as the peer's result — ban/exclusion
+    evidence, with ``.nbytes`` carrying the observed frame length).
+    ``pass_empty`` lets the SSMW stop sentinel (an empty frame) through
+    undecoded."""
+    d0, d1 = split
+
+    def transform(idx, payload):
+        if pass_empty and not payload:
+            return payload
+        t0 = time.perf_counter()
+        try:
+            vec = wire.decode(payload)
+            if vec.size != d0 + d1:
+                raise wire.WireError(
+                    f"frame has {vec.size} elements, expected {d0 + d1}"
+                )
+        except wire.WireError as exc:
+            exc.nbytes = len(payload)
+            raise
+        head, tail = vec[:d0], vec[d0:]
+        if _eager_h2d():
+            try:
+                head = jax.device_put(head)
+            except Exception:  # noqa: BLE001 — host row still works
+                pass  # jnp.stack uploads at harvest instead
+        if stats is not None:
+            stats.received(len(payload), time.perf_counter() - t0)
+        return head, tail
+
+    return transform
+
+
 def _setup(args):
     """Shared ingredients for both roles."""
     cfg = multihost.ClusterConfig(args.cluster)
@@ -365,26 +467,41 @@ def run(args):
         ex.close()
 
 
-def _gradient_quorum(ex, step, q, good_ranks, expect_bytes, republish,
-                     timeout_ms, who):
+def _gradient_quorum(ex, step, q, good_ranks, split, republish,
+                     timeout_ms, who, stats=None, wait_fn=None):
     """The PS-side gradient quorum, shared by SSMW and MSMW.
 
     A Byzantine PROCESS controls its wire bytes, not just its values: a
-    wrong-length payload cannot enter the GAR (frombuffer/stack would
-    throw) and proves its sender Byzantine — exclude the rank from all
-    future quorums and re-collect from the rest (the frames already
-    received return instantly). A quorum TIMEOUT triggers ``republish``
-    before the final attempt: the model plane is fire-and-forget, so
-    workers whose listener bound after this step's publish (cold start)
-    would otherwise never see a frame to catch up to and the healthy
-    cluster would deadlock. Returns ``(got, good_ranks)``.
+    frame the wire codec rejects (bad magic/dtype tag/element count/crc
+    or a truncation — ``_frame_transform`` stores the ``WireError`` as
+    that rank's result) cannot enter the GAR and proves its sender
+    Byzantine — exclude the rank from all future quorums and re-collect
+    from the rest (the frames already received return instantly). A
+    quorum TIMEOUT triggers ``republish`` before the final attempt: the
+    model plane is fire-and-forget, so workers whose listener bound
+    after this step's publish (cold start) would otherwise never see a
+    frame to catch up to and the healthy cluster would deadlock.
+    ``wait_fn`` is the caller's pre-registered ``collect_begin`` harvest
+    for the overlap fast path (consumed on the first attempt only —
+    retries re-collect over the surviving ranks). Returns
+    ``(got, good_ranks)`` with every ``got`` value a decoded
+    ``(grad_row, stats_row)`` pair.
     """
+    transform = _frame_transform(split, stats)
     attempts = 0
     while True:
         try:
-            got = ex.collect(
-                step, q, peers=good_ranks, timeout_ms=timeout_ms
-            )
+            if wait_fn is not None:
+                # Clear BEFORE harvesting: a timed-out registration must
+                # not be re-harvested on the retry path (its waiter
+                # threads have already expired).
+                w, wait_fn = wait_fn, None
+                got = w()
+            else:
+                got = ex.collect(
+                    step, q, peers=good_ranks, timeout_ms=timeout_ms,
+                    transform=transform,
+                )
         except TimeoutError:
             attempts += 1
             if attempts >= 3:
@@ -398,18 +515,19 @@ def _gradient_quorum(ex, step, q, good_ranks, expect_bytes, republish,
             )
             republish()
             continue
-        bad = [k for k in got if len(got[k]) != expect_bytes]
+        bad = [k for k in got if isinstance(got[k], Exception)]
         if not bad:
             return got, good_ranks
         for k in bad:
             tools.warning(
-                f"[{who}] worker rank {k} sent a malformed "
-                f"{len(got[k])}-byte gradient (expected {expect_bytes}); "
-                "excluding it from all future quorums"
+                f"[{who}] worker rank {k} sent a gradient frame that "
+                f"failed the wire codec ({got[k]}); excluding it from "
+                "all future quorums"
             )
             tele_hooks.emit_event(
                 "quorum_exclusion", who=who, step=int(step), rank=int(k),
-                got_bytes=len(got[k]), expect_bytes=int(expect_bytes),
+                got_bytes=int(getattr(got[k], "nbytes", -1)),
+                why=str(got[k]),
             )
         good_ranks = [k for k in good_ranks if k not in bad]
         if len(good_ranks) < q:
@@ -442,9 +560,9 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
     gar = gars[args.gar]
     opt_state0 = optimizer.init(params0)
     bn0_flat, bn_unravel = ravel_pytree(ms0)
-    bn_bytes = int(np.asarray(bn0_flat).size) * 4
+    bn_elems = int(np.asarray(bn0_flat).size)
     bn_mean = np.asarray(bn0_flat, np.float32)
-    if bn_bytes and f and q < 2 * f + 1:
+    if bn_elems and f and q < 2 * f + 1:
         tools.warning(
             f"BN-stat aggregation: the quorum q={q} is below 2*fw+1="
             f"{2 * f + 1}, so the f-trimmed mean clamps to the coordinate-"
@@ -515,9 +633,14 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
     t0 = time.time()
     flat = np.asarray(flat0, np.float32)
     flat_dev, opt_state = jnp.asarray(flat), opt_state0
-    d_bytes = flat.size * 4
     good_ranks = list(worker_ranks)
     losses_seen = 0
+    # Wire plane (DESIGN.md §11): every data frame goes through the typed
+    # codec — encode once per step here, decode eagerly per arriving frame
+    # in the exchange waiter threads (``_frame_transform``).
+    wire_stats = _WireStats("cluster-ps")
+    split = (flat.size, bn_elems)
+    grad_tf = _frame_transform(split, wire_stats)
     # PS-side checkpoint/resume (utils/checkpoint.py — the deliberate
     # upgrade over the reference, which has none; the on-mesh analog with
     # sharded TrainState + bit-exact rng replay lives in common.train).
@@ -537,52 +660,69 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
             restored = ckpt.restore(
                 {"flat": flat, "opt_state": jax.tree.map(
                     np.asarray, opt_state),
-                 **({"bn": bn_mean} if bn_bytes else {})},
+                 **({"bn": bn_mean} if bn_elems else {})},
                 step=step,
             )
             flat = np.asarray(restored["flat"], np.float32)
             flat_dev = jnp.asarray(flat)
             opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
-            if bn_bytes:
+            if bn_elems:
                 bn_mean = np.asarray(restored["bn"], np.float32)
             start_iter = last_saved = int(step)
             print(f"[cluster-ps] resumed from step {start_iter}", flush=True)
+    grad_wait = None
+    if start_iter < args.num_iter:
+        grad_wait = ex.collect_begin(
+            start_iter, q, timeout_ms=timeout_ms, peers=good_ranks,
+            transform=grad_tf,
+        )
     for i in range(start_iter, args.num_iter):
         t_step = time.time()
-        ex.publish(i, flat.tobytes() + bn_mean.tobytes(), to=worker_ranks)
-        got, good_ranks = _gradient_quorum(
-            ex, i, q, good_ranks, d_bytes + bn_bytes,
-            lambda: ex.publish(
-                i, flat.tobytes() + bn_mean.tobytes(), to=worker_ranks
-            ),
-            timeout_ms, "cluster-ps",
+        frame = _encode_frame(
+            [flat] + ([bn_mean] if bn_elems else []),
+            wire_stats, fanout=len(worker_ranks),
         )
+        ex.publish(i, frame, to=worker_ranks)
+        got, good_ranks = _gradient_quorum(
+            ex, i, q, good_ranks, split,
+            lambda: ex.publish(i, frame, to=worker_ranks),
+            timeout_ms, "cluster-ps", stats=wire_stats, wait_fn=grad_wait,
+        )
+        # Overlap (DESIGN.md §11): the NEXT round's collect is registered
+        # before this round's device update/eval, so fast workers'
+        # next-round gradients are latched + decoded + device-staged by
+        # the waiter threads while the PS is still updating/evaluating.
+        if i + 1 < args.num_iter:
+            grad_wait = ex.collect_begin(
+                i + 1, q, timeout_ms=timeout_ms, peers=good_ranks,
+                transform=grad_tf,
+            )
         # Deterministic composition: of the >= q arrivals, aggregate the q
-        # lowest ranks (the GAR's n is static under jit).
-        frames = [
-            np.frombuffer(got[k], np.float32) for k in sorted(got)[:q]
-        ]
-        rows = [fr[: flat.size] for fr in frames]
-        if bn_bytes:
+        # lowest ranks (the GAR's n is static under jit). Rows arrive
+        # pre-decoded (and device-staged) from the waiter threads.
+        quorum = sorted(got)[:q]
+        stack = jnp.stack([got[k][0] for k in quorum])
+        if bn_elems:
             # Robust coordinate-wise aggregation of the quorum's BatchNorm
             # stats (trim f per side; plain mean at f=0 == the on-mesh
             # core.mean_model_state) — see _robust_stats.
             bn_mean = _robust_stats(
-                np.stack([fr[flat.size:] for fr in frames]), f
+                np.stack([got[k][1] for k in quorum]), f
             )
         flat_dev, opt_state = ps_update(
-            flat_dev, opt_state, jnp.asarray(np.stack(rows)),
+            flat_dev, opt_state, stack,
             jnp.asarray(i, jnp.int32),
         )
         flat = np.asarray(flat_dev, np.float32)  # next step's publication
+        wire_stats.flush(i)
         if tele_hub is not None:
             # Worker index = exchange rank - first worker rank; the q
             # quorum members are the observed ranks this step.
             sel = jnp.asarray(
-                [k - worker_ranks[0] for k in sorted(got)[:q]], jnp.int32
+                [k - worker_ranks[0] for k in quorum], jnp.int32
             )
             tele_hub.record_step(
-                i, tap=tap_fn(jnp.asarray(np.stack(rows)), sel),
+                i, tap=tap_fn(stack, sel),
                 step_time_s=time.time() - t_step,
             )
         losses_seen = i + 1
@@ -590,7 +730,7 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
             ckpt.save(i + 1, {
                 "flat": flat,
                 "opt_state": jax.tree.map(np.asarray, opt_state),
-                **({"bn": bn_mean} if bn_bytes else {}),
+                **({"bn": bn_mean} if bn_elems else {}),
             })
             last_saved = i + 1
         if args.acc_freq and i % args.acc_freq == 0:
@@ -612,7 +752,7 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
             ckpt.save(args.num_iter, {
                 "flat": flat,
                 "opt_state": jax.tree.map(np.asarray, opt_state),
-                **({"bn": bn_mean} if bn_bytes else {}),
+                **({"bn": bn_mean} if bn_elems else {}),
             })
         ckpt.close()
     summary = {
@@ -747,11 +887,13 @@ def _shrink_fps(model_gar_name, n_ps, fps):
     return "median", 0
 
 
-def _collect_models(ex, step, plane, timeout_ms, expect_bytes):
+def _collect_models(ex, step, plane, timeout_ms, split, stats=None,
+                    wait_fn=None):
     """The MSMW model plane: the live PS models for ``step``, stacked by
     rank (``plane.ranks`` after any degradation).
 
-    A malformed frame (a Byzantine PROCESS controls its wire bytes) is
+    A frame that fails the wire codec (a Byzantine PROCESS controls its
+    wire bytes; ``_frame_transform`` stores the ``WireError``) is
     replaced by a ZERO row — a crash-like value fault inside the fps
     budget — with a warning. On repeated timeout the plane DEGRADES
     instead of raising (VERDICT r4 #7), under ``_ModelPlane``'s
@@ -764,15 +906,26 @@ def _collect_models(ex, step, plane, timeout_ms, expect_bytes):
     reveals the plane has MOVED AHEAD of ``step`` (this caller resumed
     or straggled behind its peers) raises ``_Lapped`` so the caller can
     jump. Raises TimeoutError only when every peer slot is silent.
+    ``wait_fn`` is the caller's pre-registered harvest (overlap fast
+    path; first attempt only). Returns ``(params_rows, bn_rows)``: the
+    device-staged params stack and the host stats stack (None when the
+    model carries no stats).
     """
     who = plane.who
+    transform = _frame_transform(split, stats)
     attempts = 0
     while True:
         try:
-            got = ex.collect(
-                step, len(plane.ranks), peers=plane.ranks,
-                timeout_ms=timeout_ms,
-            )
+            if wait_fn is not None:
+                # Clear BEFORE harvesting (retries must re-collect, not
+                # re-harvest an expired registration).
+                w, wait_fn = wait_fn, None
+                got = w()
+            else:
+                got = ex.collect(
+                    step, len(plane.ranks), peers=plane.ranks,
+                    timeout_ms=timeout_ms, transform=transform,
+                )
             break
         except TimeoutError:
             attempts += 1
@@ -825,20 +978,22 @@ def _collect_models(ex, step, plane, timeout_ms, expect_bytes):
             if not heard:
                 raise
             attempts = 0  # someone is alive and moving; keep waiting
-    d_bytes = expect_bytes
-    rows = []
+    d0, d1 = split
+    rows, bn_rows = [], []
     for r in sorted(plane.ranks):
-        buf = got.get(r, b"")
-        if len(buf) != d_bytes:
+        v = got.get(r)
+        if v is None or isinstance(v, Exception):
             tools.warning(
-                f"[{who}] PS rank {r} sent a malformed {len(buf)}-byte "
-                f"model at step {step} (expected {d_bytes}); substituting "
-                "zeros (a value fault inside the fps budget)"
+                f"[{who}] PS rank {r} sent a model frame at step {step} "
+                f"that failed the wire codec ({v}); substituting zeros "
+                "(a value fault inside the fps budget)"
             )
-            rows.append(np.zeros(d_bytes // 4, np.float32))
+            rows.append(np.zeros(d0, np.float32))
+            bn_rows.append(np.zeros(d1, np.float32))
         else:
-            rows.append(np.frombuffer(buf, np.float32))
-    return np.stack(rows)
+            rows.append(v[0])
+            bn_rows.append(v[1])
+    return jnp.stack(rows), (np.stack(bn_rows) if d1 else None)
 
 
 class _Lapped(Exception):
@@ -899,7 +1054,7 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
     gar_params = dict(getattr(args, "gar_params", None) or {})
     opt_state = optimizer.init(params0)
     bn0_flat, bn_unravel = ravel_pytree(ms0)
-    bn_bytes = int(np.asarray(bn0_flat).size) * 4
+    bn_elems = int(np.asarray(bn0_flat).size)
     bn = np.asarray(bn0_flat, np.float32)
     test_batches = parallel.EvalSet(
         test_batches, binary=args.dataset == "pima"
@@ -947,8 +1102,11 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
     t0 = time.time()
     flat = np.asarray(flat0, np.float32)
     flat_dev = jnp.asarray(flat)  # --num_iter 0: eval the init model
-    d_bytes = flat.size * 4
     good_ranks = list(worker_ranks)
+    wire_stats = _WireStats(who)
+    split = (flat.size, bn_elems)
+    model_tf = _frame_transform(split, wire_stats)
+    grad_tf = _frame_transform(split, wire_stats)
     ckpt = None
     start_iter = last_saved = 0
     if args.checkpoint_dir:
@@ -964,24 +1122,23 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
             restored = ckpt.restore(
                 {"flat": flat, "opt_state": jax.tree.map(
                     np.asarray, opt_state),
-                 **({"bn": bn} if bn_bytes else {})},
+                 **({"bn": bn} if bn_elems else {})},
                 step=step,
             )
             flat = np.asarray(restored["flat"], np.float32)
             flat_dev = jnp.asarray(flat)
             opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
-            if bn_bytes:
+            if bn_elems:
                 bn = np.asarray(restored["bn"], np.float32)
             start_iter = last_saved = int(step)
             print(f"[{who}] resumed from step {start_iter}", flush=True)
     losses_seen = start_iter
     i = start_iter
+    model_wait = grad_wait = None
     while i < args.num_iter:
-        frame = flat.tobytes() + (bn.tobytes() if bn_bytes else b"")
+        vec = np.concatenate([flat, bn]) if bn_elems else flat
         if model_attack is not None:
-            frame = model_attack(
-                np.frombuffer(frame, np.float32)
-            ).astype(np.float32).tobytes()
+            vec = model_attack(vec).astype(np.float32)
         # Fan out to the FULL original plane (a dead rank costs one
         # bounded sender queue; excluding a merely-slow rank would starve
         # it into a real partition — _ModelPlane docstring). NOTE: after a
@@ -992,40 +1149,53 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
         everyone = [
             r for r in plane.all_ranks if r != ex.my_index
         ] + list(worker_ranks)
+        frame = _encode_frame([vec], wire_stats, fanout=len(everyone))
         ex.publish(i, frame, to=everyone)
         try:
-            models = _collect_models(
-                ex, i, plane, timeout_ms,
-                expect_bytes=d_bytes + bn_bytes,
+            models_p, models_bn = _collect_models(
+                ex, i, plane, timeout_ms, split,
+                stats=wire_stats, wait_fn=model_wait,
             )
         except _Lapped as lap:
             # Resumed/straggled behind the peers: jump to their round; the
-            # gather step there re-synchronizes the model (docstring).
+            # gather step there re-synchronizes the model (docstring). Any
+            # pre-registered waiters for the abandoned round self-expire
+            # when the plane's slots advance past it.
             tools.warning(
                 f"[{who}] behind the model plane at round {i}; jumping "
                 f"to round {lap.newest}"
             )
             i = lap.newest
+            model_wait = grad_wait = None
             continue
-        flat_dev = jnp.asarray(
-            plane.aggregate(models[:, : flat.size])
-        )
-        if bn_bytes:
+        model_wait = None  # consumed
+        flat_dev = plane.aggregate(models_p)
+        if bn_elems:
             # Model-plane BN aggregate (fps budget) — BLENDED with the
             # worker quorum's stats below, not overwritten (ADVICE r5 #2:
             # the old assignment here was dead, so replicas never actually
             # reconciled BN state).
-            bn_plane = _robust_stats(models[:, flat.size:], plane.fps)
+            bn_plane = _robust_stats(models_bn, plane.fps)
         got, good_ranks = _gradient_quorum(
-            ex, i, q, good_ranks, d_bytes + bn_bytes,
+            ex, i, q, good_ranks, split,
             lambda: ex.publish(i, frame, to=everyone),
-            timeout_ms, who,
+            timeout_ms, who, stats=wire_stats, wait_fn=grad_wait,
         )
-        frames = [
-            np.frombuffer(got[k], np.float32) for k in sorted(got)[:q]
-        ]
-        rows = [fr[: flat.size] for fr in frames]
-        if bn_bytes:
+        # Overlap (DESIGN.md §11): next round's planes registered before
+        # the device update/eval — peer models and fast workers' gradients
+        # decode + stage while this replica still computes.
+        if i + 1 < args.num_iter:
+            model_wait = ex.collect_begin(
+                i + 1, len(plane.ranks), timeout_ms=timeout_ms,
+                peers=plane.ranks, transform=model_tf,
+            )
+            grad_wait = ex.collect_begin(
+                i + 1, q, timeout_ms=timeout_ms, peers=good_ranks,
+                transform=grad_tf,
+            )
+        quorum = sorted(got)[:q]
+        stack = jnp.stack([got[k][0] for k in quorum])
+        if bn_elems:
             # BN reconciliation mirrors the params: equal-weight blend of
             # the peer replicas' robust-aggregated stats (published next
             # round) with this quorum's fresh worker stats. Replicas see
@@ -1036,26 +1206,27 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
             # pmean over the ps axis, parallel/byzsgd.py, is the
             # limit-case of this blend).
             bn = 0.5 * (bn_plane + _robust_stats(
-                np.stack([fr[flat.size:] for fr in frames]), f
+                np.stack([got[k][1] for k in quorum]), f
             ))
         flat_dev, opt_state = ps_update(
-            flat_dev, opt_state, jnp.asarray(np.stack(rows)),
+            flat_dev, opt_state, stack,
             jnp.asarray(i, jnp.int32),
         )
         flat = np.asarray(flat_dev, np.float32)
+        wire_stats.flush(i)
         if tele_hub is not None:
             sel = jnp.asarray(
-                [k - worker_ranks[0] for k in sorted(got)[:q]], jnp.int32
+                [k - worker_ranks[0] for k in quorum], jnp.int32
             )
             tele_hub.record_step(
-                i, tap=tap_fn(jnp.asarray(np.stack(rows)), sel),
+                i, tap=tap_fn(stack, sel),
             )
         losses_seen = i + 1
         if ckpt and args.checkpoint_freq and (i + 1) % args.checkpoint_freq == 0:
             ckpt.save(i + 1, {
                 "flat": flat,
                 "opt_state": jax.tree.map(np.asarray, opt_state),
-                **({"bn": bn} if bn_bytes else {}),
+                **({"bn": bn} if bn_elems else {}),
             })
             last_saved = i + 1
         if args.acc_freq and i % args.acc_freq == 0:
@@ -1080,7 +1251,7 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
             ckpt.save(args.num_iter, {
                 "flat": flat,
                 "opt_state": jax.tree.map(np.asarray, opt_state),
-                **({"bn": bn} if bn_bytes else {}),
+                **({"bn": bn} if bn_elems else {}),
             })
         ckpt.close()
     summary = {
@@ -1208,33 +1379,38 @@ def _run_learn(args):
             ),
         )
 
-    def harvest(wait_fn, num_elems):
+    def harvest(wait_fn, split):
         """Drain a pre-registered quorum, stack the q lowest-rank
-        WELL-FORMED rows. Malformed frames (Byzantine wire bytes) are
-        filtered FIRST, so an extra well-formed frame from a higher rank
-        replaces a malformed lower one (ADVICE r4: discarding honest data
-        while feeding the GAR substitute zeros would hand the attacker a
-        second fault for free); zero rows — a crash-like value fault
-        inside the f budget — pad only when fewer than q well-formed
-        frames exist."""
+        WELL-FORMED rows (frames arrive pre-decoded and device-staged by
+        ``_frame_transform`` on the waiter threads). Frames the wire
+        codec rejected (Byzantine wire bytes — the stored ``WireError``)
+        are filtered FIRST, so an extra well-formed frame from a higher
+        rank replaces a malformed lower one (ADVICE r4: discarding honest
+        data while feeding the GAR substitute zeros would hand the
+        attacker a second fault for free); zero rows — a crash-like value
+        fault inside the f budget — pad only when fewer than q
+        well-formed frames exist. Returns ``(rows, bn_rows)`` stacks
+        (``bn_rows`` None when the plane carries no stats segment)."""
         got = wait_fn()
-        d_bytes = num_elems * 4
+        d0, d1 = split
         well_formed = []
         for k in sorted(got):
-            if len(got[k]) == d_bytes:
-                well_formed.append(k)
+            v = got[k]
+            if not isinstance(v, Exception):
+                well_formed.append(v)
             elif k not in warned_malformed:  # once per peer, not per round
                 warned_malformed.add(k)
                 tools.warning(
-                    f"[{who}] peer rank {k} sent a malformed "
-                    f"{len(got[k])}-byte frame (expected {d_bytes}); "
-                    "dropping its malformed frames from every quorum "
-                    "(warned once)"
+                    f"[{who}] peer rank {k} sent a frame that failed the "
+                    f"wire codec ({v}); dropping its malformed frames "
+                    "from every quorum (warned once)"
                 )
-        rows = [np.frombuffer(got[k], np.float32) for k in well_formed[:q]]
+        rows = [v[0] for v in well_formed[:q]]
+        bn_rows = [v[1] for v in well_formed[:q]]
         while len(rows) < q:
-            rows.append(np.zeros(num_elems, np.float32))
-        return np.stack(rows)
+            rows.append(np.zeros(d0, np.float32))
+            bn_rows.append(np.zeros(d1, np.float32))
+        return jnp.stack(rows), (np.stack(bn_rows) if d1 else None)
 
     who = f"cluster-node-{me}"
     warned_malformed = set()
@@ -1252,6 +1428,14 @@ def _run_learn(args):
     bn_elems = int(np.asarray(bn0_flat).size)
     num_batches = my_xs.shape[0]
     dropped_at = None
+    # Wire plane (DESIGN.md §11): LEARN's gradient plane ships bare
+    # gradients, the gossip plane [params || stats] — both through the
+    # typed codec, decoded eagerly by the pre-registered waiters.
+    wire_stats = _WireStats(who)
+    grad_split = (flat.size, 0)
+    gossip_split = (flat.size, bn_elems)
+    grad_tf = _frame_transform(grad_split, wire_stats)
+    gossip_tf = _frame_transform(gossip_split, wire_stats)
     # Per-node checkpoint/resume (r5): each peer persists its OWN model +
     # optimizer + BN stats under checkpoint_dir/node_{me}. Resume expects
     # the whole deployment to restart from a common step (the round-
@@ -1358,10 +1542,12 @@ def _run_learn(args):
             eat the round budget)."""
             return (
                 ex.collect_begin(
-                    2 * i + 2, q, timeout_ms=args.cluster_timeout_ms
+                    2 * i + 2, q, timeout_ms=args.cluster_timeout_ms,
+                    transform=grad_tf,
                 ),
                 ex.collect_begin(
-                    2 * i + 3, q, timeout_ms=args.cluster_timeout_ms
+                    2 * i + 3, q, timeout_ms=args.cluster_timeout_ms,
+                    transform=gossip_tf,
                 ),
             )
 
@@ -1406,9 +1592,12 @@ def _run_learn(args):
                     g = mom.astype(np.float32)
                 if attack is not None:
                     g = attack(g)
-            ex.publish(2 * i + 2, g.tobytes())
+            ex.publish(
+                2 * i + 2,
+                _encode_frame([g], wire_stats, fanout=n - 1),
+            )
             try:
-                grads = harvest(grad_wait, g.size)
+                grads, _ = harvest(grad_wait, grad_split)
             except TimeoutError:
                 # Dropped out of the quorum flow: the reference's pull
                 # loops retry a bounded number of times then exit
@@ -1421,7 +1610,7 @@ def _run_learn(args):
                 )
                 break
             flat_dev, opt_state = node_update(
-                flat_dev, opt_state, jnp.asarray(grads),
+                flat_dev, opt_state, grads,
                 jnp.asarray(i, jnp.int32),
             )
             flat = np.asarray(flat_dev, np.float32)
@@ -1439,25 +1628,28 @@ def _run_learn(args):
                 ])
             if model_attack is not None:
                 pub = model_attack(pub).astype(np.float32)
-            ex.publish(2 * i + 3, pub.tobytes())
+            ex.publish(
+                2 * i + 3,
+                _encode_frame([pub], wire_stats, fanout=n - 1),
+            )
             try:
-                models = harvest(model_wait, flat.size + bn_elems)
+                models_p, models_bn = harvest(model_wait, gossip_split)
             except TimeoutError:
                 tools.warning(
                     f"[{who}] lost the round-{i} model-gossip quorum; "
                     "keeping the locally updated model this round"
                 )
-                models = None
-            if models is not None:
+                models_p = None
+            if models_p is not None:
                 flat_dev = model_aggregate(
-                    jnp.asarray(models[:, : flat.size]),
-                    jnp.asarray(i, jnp.int32),
+                    models_p, jnp.asarray(i, jnp.int32),
                 )
                 flat = np.asarray(flat_dev, np.float32)
                 if bn_elems:
                     ms = bn_unravel(jnp.asarray(
-                        _robust_stats(models[:, flat.size:], f)
+                        _robust_stats(models_bn, f)
                     ))
+            wire_stats.flush(i)
             if (ckpt and args.checkpoint_freq
                     and (i + 1) % args.checkpoint_freq == 0):
                 ckpt.save(i + 1, {
@@ -1574,72 +1766,95 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
 
     base_key = jax.random.PRNGKey(args.seed + 1 + windex)
     flat_np = np.asarray(flat0, np.float32)
-    d_bytes = flat_np.size * 4
     # SSMW BN-stat exchange (see _run_ps docstring): model frames arrive as
     # [params || mean batch_stats] and gradient frames ship
     # [grad || this worker's updated batch_stats]; d_bn = 0 models keep the
     # plain layout.
     bn0_flat, bn_unravel = ravel_pytree(ms0)
-    bn_bytes = int(np.asarray(bn0_flat).size) * 4
+    bn_elems = int(np.asarray(bn0_flat).size)
+    who = f"cluster-worker-{windex}"
+    wire_stats = _WireStats(who)
+    split = (flat_np.size, bn_elems)
+    # pass_empty: the PS's stop sentinel is an empty frame, not a codec
+    # frame — it must reach the loop's sentinel check undecoded.
+    model_tf = _frame_transform(split, wire_stats, pass_empty=True)
     num_batches = my_xs.shape[0]
     multi_ps = len(ps_ranks) > 1
     if multi_ps:
         fps = getattr(args, "fps", 0)
         model_gar_name = getattr(args, "model_gar", None) or args.gar
-        plane = _ModelPlane(
-            ps_ranks, model_gar_name, fps, f"cluster-worker-{windex}"
-        )
+        plane = _ModelPlane(ps_ranks, model_gar_name, fps, who)
 
     ms = ms0
     loss = None
     steps_done = 0
     i = 0
+    # Overlap (DESIGN.md §11): the model read is registered BEFORE the
+    # local gradient compute each round, so the next model frame is
+    # latched + decoded + device-staged by the watcher thread while this
+    # worker is still inside its own device step.
+    model_wait = None
+    if not multi_ps:
+        model_wait = ex.read_latest_begin(0, 0, transform=model_tf)
     while i < args.num_iter:
         if multi_ps:
             step = i
             try:
-                models = _collect_models(
-                    ex, i, plane, timeout_ms,
-                    expect_bytes=d_bytes + bn_bytes,
+                models_p, models_bn = _collect_models(
+                    ex, i, plane, timeout_ms, split,
+                    stats=wire_stats, wait_fn=model_wait,
                 )
             except _Lapped as lap:
                 # MSMW catch-up: a worker outside the PSes' q-fastest
                 # quorum is lapped — jump to the plane's newest round
                 # (the MSMW twin of the SSMW read_latest jump).
+                model_wait = None
                 if lap.newest >= args.num_iter:
                     break
                 tools.warning(
-                    f"[cluster-worker-{windex}] lapped at round {i}; "
+                    f"[{who}] lapped at round {i}; "
                     f"jumping to the PSes' round {lap.newest}"
                 )
                 i = lap.newest
                 continue
-            flat_params = plane.aggregate(models[:, : flat_np.size])
-            if bn_bytes:
+            model_wait = None  # consumed
+            if i + 1 < args.num_iter:
+                model_wait = ex.collect_begin(
+                    i + 1, len(plane.ranks), timeout_ms=timeout_ms,
+                    peers=plane.ranks, transform=model_tf,
+                )
+            flat_params = plane.aggregate(models_p)
+            if bn_elems:
                 # Adopt the robust-aggregated PS statistics (fps budget),
                 # the MSMW twin of the SSMW mean-stats adoption.
                 ms = bn_unravel(jnp.asarray(
-                    _robust_stats(models[:, flat_np.size:], plane.fps)
+                    _robust_stats(models_bn, plane.fps)
                 ))
         else:
-            step, payload = ex.read_latest(0, i, timeout_ms=timeout_ms)
-            if step >= args.num_iter or not payload:
+            step, payload = model_wait(timeout_ms=timeout_ms)
+            if step >= args.num_iter or payload == b"":
                 break  # PS's stop sentinel (empty frame at num_iter)
-            if len(payload) != d_bytes + bn_bytes:
-                # NOT the sentinel: a non-empty model frame of the wrong
-                # size means the PS runs a different model/dtype config — a
-                # deployment error that must fail loudly, not exit rc 0.
+            if isinstance(payload, Exception):
+                # NOT the sentinel: the trusted PS's model frame failing
+                # the wire codec means the PS runs a different model/dtype
+                # config — a deployment error that must fail loudly, not
+                # exit rc 0.
                 raise SystemExit(
-                    f"model frame is {len(payload)} bytes but this worker's "
-                    f"model+stats flatten to {d_bytes + bn_bytes}; PS and "
-                    "worker configs disagree (--model/--dtype/--dataset)"
+                    f"model frame failed the wire codec ({payload}); PS "
+                    "and worker configs disagree (--model/--dtype/"
+                    "--dataset)"
                 )
-            frame = np.frombuffer(payload, np.float32)
-            flat_params = jnp.asarray(frame[: flat_np.size])
-            if bn_bytes:
+            # Next round's read registered before the compute; the
+            # watcher keeps latching newer rounds, so the straggler
+            # catch-up semantics survive the pre-registration.
+            model_wait = ex.read_latest_begin(
+                0, step + 1, transform=model_tf
+            )
+            flat_params, bn_seg = payload
+            if bn_elems:
                 # Adopt the PS's mean BatchNorm statistics — the cluster
                 # twin of the on-mesh core.mean_model_state sync.
-                ms = bn_unravel(jnp.asarray(frame[flat_np.size:]))
+                ms = bn_unravel(jnp.asarray(bn_seg))
         if atk_kind == "cohort":
             # Colluding attacker (byzWorker.py:114-125): compute the
             # cohort's honest gradients locally on DISTINCT batches of the
@@ -1676,17 +1891,18 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
                 g = mom.astype(np.float32)
             if attack is not None:
                 g = attack(g)
-        out_frame = g.tobytes()
-        if bn_bytes:
-            # Both deployment shapes ship [grad || stats] now (MSMW BN
-            # plane, r5); the PS robust-aggregates the stats segment.
-            out_frame += np.asarray(
-                ravel_pytree(ms)[0], np.float32
-            ).tobytes()
+        out_parts = [g]
+        if bn_elems:
+            # Both deployment shapes ship [grad || stats] (MSMW BN plane,
+            # r5); the PS robust-aggregates the stats segment.
+            out_parts.append(np.asarray(ravel_pytree(ms)[0], np.float32))
+        targets = plane.all_ranks if multi_ps else ps_ranks
         ex.publish(
-            step, out_frame,
-            to=plane.all_ranks if multi_ps else ps_ranks,
+            step,
+            _encode_frame(out_parts, wire_stats, fanout=len(targets)),
+            to=targets,
         )
+        wire_stats.flush(step)
         if (mom_path is not None and mom is not None
                 and args.checkpoint_freq
                 and (step + 1) % args.checkpoint_freq == 0):

@@ -7,12 +7,14 @@ BENCH_r* capture, dashboards, the tier-1 schema check — parse one format:
     {"schema": "garfield-telemetry", "v": 1, "kind": <kind>, ...}
 
 Kinds: ``run`` (header: config/meta), ``step`` (per-step tap + loss +
-timing), ``event`` (liveness / exchange waits), ``summary`` (run-closing
-suspicion + counters), ``bench`` (bench.py's north-star line), and
-``gar_bench`` (per-cell kernel latencies). ``validate_record`` /
-``validate_jsonl`` are stdlib-only and run in the tier-1 suite, so a
-malformed artifact fails loudly instead of going dark (the BENCH_r05
-rc=1 post-mortem this subsystem exists for).
+timing), ``event`` (liveness / exchange waits / wire accounting),
+``summary`` (run-closing suspicion + counters + wire totals), ``bench``
+(bench.py's north-star line), ``gar_bench`` (per-cell kernel latencies),
+``transfer_bench`` (mesh all-gather cells), and ``exchange_bench``
+(host-plane publish/collect cells — the wire-codec A/B record).
+``validate_record`` / ``validate_jsonl`` are stdlib-only and run in the
+tier-1 suite, so a malformed artifact fails loudly instead of going dark
+(the BENCH_r05 rc=1 post-mortem this subsystem exists for).
 """
 
 import json
@@ -32,7 +34,8 @@ __all__ = [
 SCHEMA = "garfield-telemetry"
 SCHEMA_VERSION = 1
 
-KINDS = ("run", "step", "event", "summary", "bench", "gar_bench")
+KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
+         "transfer_bench", "exchange_bench")
 
 
 def make_record(kind, **fields):
@@ -153,6 +156,34 @@ def validate_record(rec):
         lat = rec.get("latency_s")
         if lat is not None and not _is_num(lat):
             _fail(f"gar_bench.latency_s must be a number or null, got {lat!r}")
+    elif kind == "transfer_bench":
+        for key in ("devices", "d"):
+            val = rec.get(key)
+            if not isinstance(val, int) or isinstance(val, bool):
+                _fail(f"transfer_bench.{key} must be an int, got {val!r}")
+        lat = rec.get("latency_s")
+        if lat is not None and not _is_num(lat):
+            _fail(
+                f"transfer_bench.latency_s must be a number or null, "
+                f"got {lat!r}"
+            )
+    elif kind == "exchange_bench":
+        for key in ("n", "d"):
+            val = rec.get(key)
+            if not isinstance(val, int) or isinstance(val, bool):
+                _fail(f"exchange_bench.{key} must be an int, got {val!r}")
+        if not isinstance(rec.get("wire"), str):
+            _fail(
+                f"exchange_bench.wire must be a string, got "
+                f"{rec.get('wire')!r}"
+            )
+        for key in ("round_s", "wire_bytes_per_step"):
+            val = rec.get(key)
+            if val is not None and not _is_num(val):
+                _fail(
+                    f"exchange_bench.{key} must be a number or null, "
+                    f"got {val!r}"
+                )
     # kind == "run": meta payload is free-form (validated as JSON above).
     return rec
 
@@ -215,6 +246,20 @@ def prometheus_text(hub):
     metric("garfield_step_time_seconds", "gauge",
            "Mean recorded step wall time.",
            [({}, None if st is None else st["mean_s"])])
+    w = hub.wire_counters()
+    if any(w.values()):
+        metric("garfield_wire_bytes_total", "counter",
+               "Wire bytes through the typed host-plane codec.",
+               [({"direction": "out"}, float(w["bytes_out"])),
+                ({"direction": "in"}, float(w["bytes_in"]))])
+        metric("garfield_wire_codec_seconds_total", "counter",
+               "Host seconds spent in the wire codec.",
+               [({"op": "encode"}, w["encode_s"]),
+                ({"op": "decode"}, w["decode_s"])])
+        metric("garfield_send_queue_drops_total", "counter",
+               "Publisher-side frames shed to sender-queue overflow "
+               "(backpressure; the send-side twin of plane_drop).",
+               [({}, float(w["send_queue_drops"]))])
     susp = hub.suspicion()
     if susp is not None:
         metric("garfield_rank_suspicion", "gauge",

@@ -55,6 +55,13 @@ class MetricsHub:
         self._observed = None
         self._excluded = None
         self._selected_hist = collections.deque(maxlen=120)
+        # Wire-plane accounting (DESIGN.md §11): folded from the cluster
+        # roles' per-step "wire" events and the exchange's publisher-side
+        # "send_queue_drop" events, exposed by both exporters.
+        self._wire = {
+            "bytes_out": 0, "bytes_in": 0, "frames_in": 0,
+            "encode_s": 0.0, "decode_s": 0.0, "send_queue_drops": 0,
+        }
 
     # --- feeding -----------------------------------------------------------
 
@@ -121,6 +128,13 @@ class MetricsHub:
         rec = make_record("event", event=str(kind), t=time.time(), **fields)
         with self._lock:
             self._events += 1
+            if kind == "wire":
+                for key in ("bytes_out", "bytes_in", "frames_in"):
+                    self._wire[key] += int(fields.get(key, 0) or 0)
+                for key in ("encode_s", "decode_s"):
+                    self._wire[key] += float(fields.get(key, 0.0) or 0.0)
+            elif kind == "send_queue_drop":
+                self._wire["send_queue_drops"] += 1
             self._ring.append(rec)
             self._drain(rec)
             return rec
@@ -160,6 +174,11 @@ class MetricsHub:
                 "clip_frac": self._last_clip_frac,
             }
 
+    def wire_counters(self):
+        """Cumulative wire-plane totals (bytes/codec-seconds/drops)."""
+        with self._lock:
+            return dict(self._wire)
+
     def step_time_stats(self):
         with self._lock:
             if not self._step_times:
@@ -198,6 +217,11 @@ class MetricsHub:
                         "count": len(self._step_times),
                         "mean_s": float(np.mean(self._step_times)),
                     }
+                ),
+                wire=(
+                    None if not any(self._wire.values())
+                    else {k: (round(v, 6) if isinstance(v, float) else v)
+                          for k, v in self._wire.items()}
                 ),
                 meta=self.meta,
             )

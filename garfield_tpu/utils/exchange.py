@@ -10,9 +10,13 @@ tolerance, like ``Server.get_gradients``'s wait-n-f path
 
 Reference counterparts re-designed here:
   - T1 gRPC ``MessageExchange`` (tensorflow_impl/libs/garfield.proto:3-10):
-    replaced by length-prefixed frames over plain TCP — the payloads are
-    opaque bytes exactly like the reference's ``ndarray.tobytes()`` wire
-    format (garfield.proto:24-33).
+    replaced by length-prefixed frames over plain TCP. The payloads are
+    opaque bytes at THIS layer; the cluster driver's data frames carry the
+    typed codec of ``utils.wire`` (16-byte self-describing header + f32 or
+    bf16 payload, DESIGN.md §11) where the reference shipped bare
+    ``ndarray.tobytes()`` (garfield.proto:24-33) — bf16 halves every frame
+    on the DCN and the header's crc/dtype/count make corrupted bytes ban
+    evidence instead of undetectable GAR input.
   - T2 history servicer (grpc_message_exchange_servicer.py:51-86): readers
     there spin-poll the history list at 1 ms; here the per-peer mailbox is
     the native ``MultiBuffer`` MRMW register (T9,
@@ -57,6 +61,19 @@ def _emit_wait(step, q, arrived, wait_s, timed_out=False):
     _tele_hub.emit_event(
         "exchange_wait", step=int(step), q=int(q), arrived=int(arrived),
         wait_s=round(float(wait_s), 6), timed_out=bool(timed_out),
+    )
+
+
+def _emit_send_drop(peer, step):
+    """Report one publisher-side drop-oldest (sender-queue overflow) to
+    the telemetry plane. Without this event the backpressure was SILENT —
+    a hung receiver aging frames out of its sender queue looked identical
+    to a healthy run from the publisher's telemetry (the receive-side
+    ``plane_drop`` twin of this event covers the other direction)."""
+    from ..telemetry import hub as _tele_hub
+
+    _tele_hub.emit_event(
+        "send_queue_drop", peer=int(peer), step=int(step)
     )
 
 # Slot frame with this step value is the close sentinel: it wakes every
@@ -268,12 +285,18 @@ class PeerExchange:
                 except queue.Full:
                     try:
                         q.get_nowait()  # drop the oldest frame for this peer
+                        # ``step`` is the frame being ENQUEUED, not the
+                        # dropped one (the dropped frame's step is gone
+                        # with its bytes) — close enough to localize the
+                        # backpressure in the stream.
+                        _emit_send_drop(idx, step)
                     except queue.Empty:
                         pass
 
     # --- collect (wait-n-f) ------------------------------------------------
 
-    def _wait_slot(self, idx, step, deadline_box, results, sem):
+    def _wait_slot(self, idx, step, deadline_box, results, sem,
+                   transform=None):
         """Block on the native register until peer idx publishes ``step``.
 
         Only the EXACT step joins the quorum: the register is
@@ -285,6 +308,15 @@ class PeerExchange:
         registration, the timeout clock starts at harvest); reads run in
         1 s chunks while unarmed so arming takes effect promptly.
         Intermediate older frames do not restart the deadline.
+
+        ``transform`` runs HERE, in the waiter thread, the moment the
+        frame lands — this is the eager-decode hook the cluster driver
+        uses to overlap wire decode (+ H2D staging) with the other peers'
+        receives and the local device step, instead of decoding the whole
+        quorum serially after it closes. A transform that raises has its
+        exception STORED as the peer's result (not re-raised): on the
+        quorum paths a failed decode is Byzantine ban evidence the caller
+        must see attributed to its rank, not a missing-peer timeout.
         """
         version = 0
         try:
@@ -307,14 +339,21 @@ class PeerExchange:
                 if got_step == _CLOSE_STEP:  # woken by close()
                     break
                 if got_step == step:
-                    results[idx] = raw[_SLOT.size:]
+                    payload = raw[_SLOT.size:]
+                    if transform is not None:
+                        try:
+                            payload = transform(idx, payload)
+                        except Exception as exc:  # noqa: BLE001
+                            payload = exc
+                    results[idx] = payload
                     break
                 if got_step > step:  # requested step already overwritten
                     break
         finally:
             sem.release()
 
-    def collect_begin(self, step, q, *, timeout_ms=30_000, peers=None):
+    def collect_begin(self, step, q, *, timeout_ms=30_000, peers=None,
+                      transform=None):
         """Register the waiters for ``step`` NOW; harvest with ``.wait()``.
 
         Symmetric all-to-all protocols (LEARN gossip) need this split: with
@@ -345,7 +384,7 @@ class PeerExchange:
         for idx in peers:
             t = threading.Thread(
                 target=self._wait_slot,
-                args=(idx, step, deadline_box, results, sem),
+                args=(idx, step, deadline_box, results, sem, transform),
                 daemon=True,
             )
             self._waiters.append(t)
@@ -379,7 +418,8 @@ class PeerExchange:
 
         return wait
 
-    def collect(self, step, q, *, timeout_ms=30_000, peers=None):
+    def collect(self, step, q, *, timeout_ms=30_000, peers=None,
+                transform=None):
         """Payloads of the q fastest peers (self included) at ``step``.
 
         Returns a dict {peer_index: payload} with >= q entries, or raises
@@ -390,11 +430,81 @@ class PeerExchange:
         plane) while workers wait on the PS slot only (model plane), so
         both planes share one exchange without cross-talk. For symmetric
         protocols use ``collect_begin`` (see its docstring for the
-        publish-then-collect race it closes).
+        publish-then-collect race it closes). ``transform`` is the eager
+        per-frame decode hook (see ``_wait_slot``).
         """
         return self.collect_begin(
-            step, q, timeout_ms=timeout_ms, peers=peers
+            step, q, timeout_ms=timeout_ms, peers=peers, transform=transform
         )()
+
+    def read_latest_begin(self, idx, min_step, *, transform=None):
+        """Register a watcher on peer ``idx``'s slot NOW; harvest the
+        newest (step, payload) with step >= ``min_step`` via the returned
+        ``wait(timeout_ms)``.
+
+        The pre-registered twin of ``read_latest``, built for the SSMW
+        worker's model plane: registering BEFORE the local gradient
+        compute means the PS's next model frame is latched (and, with
+        ``transform``, wire-decoded + device-staged) the moment it lands
+        — while this worker is still inside its own device step — instead
+        of being discovered, decoded and uploaded serially afterwards.
+        The watcher keeps latching NEWER satisfying frames until harvest,
+        so the catch-up semantics survive: a straggler that computes
+        through several PS rounds harvests the newest model, exactly like
+        a fresh ``read_latest`` would. Transform failures are stored as
+        the payload (see ``_wait_slot``); the harvest's timeout clock
+        starts at ``wait()``, not here.
+        """
+        state = {"best": None}
+        cond = threading.Condition()
+        harvested = threading.Event()
+
+        def watch():
+            version = 0
+            while not (self._closing.is_set() or harvested.is_set()):
+                try:
+                    version, raw = self._mb.read(
+                        idx, min_version=version + 1, timeout_ms=500
+                    )
+                except TimeoutError:
+                    continue
+                (got_step,) = _SLOT.unpack_from(raw)
+                if got_step == _CLOSE_STEP:
+                    break
+                if got_step >= min_step:
+                    payload = raw[_SLOT.size:]
+                    if transform is not None:
+                        try:
+                            payload = transform(idx, payload)
+                        except Exception as exc:  # noqa: BLE001
+                            payload = exc
+                    with cond:
+                        state["best"] = (got_step, payload)
+                        cond.notify_all()
+
+        t = threading.Thread(target=watch, daemon=True)
+        self._waiters = [w for w in self._waiters if w.is_alive()]
+        self._waiters.append(t)
+        t.start()
+
+        def wait(timeout_ms=30_000):
+            deadline = time.monotonic() + timeout_ms / 1000.0
+            with cond:
+                while state["best"] is None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._closing.is_set():
+                        break
+                    cond.wait(timeout=min(remaining, 1.0))
+                best = state["best"]
+            harvested.set()  # stop latching; the watcher exits on its own
+            if best is None:
+                raise TimeoutError(
+                    f"peer {idx} did not reach step {min_step} within "
+                    f"{timeout_ms} ms"
+                )
+            return best
+
+        return wait
 
     def read_latest(self, idx, min_step, *, timeout_ms=30_000):
         """Newest (step, payload) in peer ``idx``'s slot with step >=

@@ -1,0 +1,159 @@
+"""Typed wire codec for the host plane (DESIGN.md §11).
+
+The cluster driver's frames used to be bare ``ndarray.tobytes()`` — the
+reference's wire format (garfield.proto:24-33) — which (a) ships every
+gradient/model/gossip frame at f32 width even though the on-mesh pipeline
+already proved bf16 gradients converge (PERF.md r3), and (b) gives the
+receiver nothing to validate beyond total length, so a Byzantine process
+could only be caught by a wrong-size frame. Every data frame now carries a
+16-byte self-describing header:
+
+    magic   2s   b"GW"
+    ver     u8   1
+    dtype   u8   0 = f32, 1 = bf16
+    elems   u64  logical float32 element count
+    crc32   u32  zlib.crc32 of the payload bytes
+
+``GARFIELD_WIRE_DTYPE=f32|bf16`` selects the SEND width (default f32).
+bf16 halves every gradient, model and gossip frame on the DCN; the f32
+setting keeps the payload bytes BYTE-IDENTICAL to the pre-codec
+``tobytes()`` format (modulo the header), so existing trajectory pins
+carry over. Decoding is dtype-driven by the header, never by the local
+setting — mixed-width deployments interoperate (each peer chooses its own
+send width, exactly like per-link compression).
+
+The bf16 cast is pure numpy (no jax dependency — the exchange bench and
+its child processes stay jax-free): round-to-nearest-even on the high 16
+bits of the f32 bit pattern, the same rounding XLA's ``convert`` uses, so
+a host-decoded gradient matches what the on-mesh bf16 pipeline would have
+produced for the same value. Restoring f32 is the exact ``u16 << 16``
+view — bf16 -> f32 is lossless.
+
+Why bf16-on-wire is safe UPSTREAM of the GAR: the rules aggregate at f32
+(`aggregators/_common` Gram accumulation, cclip's f32 center iteration),
+so wire quantization is a bounded per-coordinate perturbation of the
+rule's INPUT rows — a strictly weaker disturbance than the Byzantine
+value faults the f budget already absorbs, and the honest rows all carry
+the same quantization so relative geometry (distances, medians) is
+preserved to bf16 precision. The convergence smoke in tests/test_cluster
+runs the lie attack over both widths.
+"""
+
+import os
+import struct
+import zlib
+
+import numpy as np
+
+__all__ = [
+    "WIRE_DTYPES",
+    "WireError",
+    "wire_dtype",
+    "encode",
+    "decode",
+    "frame_nbytes",
+    "HEADER_NBYTES",
+]
+
+_HDR = struct.Struct("!2sBBQI")
+HEADER_NBYTES = _HDR.size  # 16
+_MAGIC = b"GW"
+_VERSION = 1
+_TAG_F32 = 0
+_TAG_BF16 = 1
+WIRE_DTYPES = ("f32", "bf16")
+_ITEMSIZE = {_TAG_F32: 4, _TAG_BF16: 2}
+
+
+class WireError(ValueError):
+    """A frame failed codec validation (bad magic/version/dtype tag,
+    truncation, length/element-count mismatch, or CRC failure). On the
+    cluster's quorum paths this is BAN EVIDENCE: a Byzantine process
+    controls its wire bytes, and a frame that fails the codec proves its
+    sender faulty exactly like the old wrong-length check."""
+
+
+def wire_dtype():
+    """The configured send width (``GARFIELD_WIRE_DTYPE``, default f32)."""
+    d = os.environ.get("GARFIELD_WIRE_DTYPE", "f32").strip().lower()
+    if d not in WIRE_DTYPES:
+        raise ValueError(
+            f"GARFIELD_WIRE_DTYPE must be one of {WIRE_DTYPES}, got {d!r}"
+        )
+    return d
+
+
+def _f32_to_bf16(vec):
+    """Round-to-nearest-even truncation of f32 to its high 16 bits (the
+    uint32 >> 16 view trick; NaN payload bits survive because the quiet
+    bit lives in the kept half)."""
+    u = vec.view(np.uint32)
+    return ((u + np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1)))
+            >> np.uint32(16)).astype(np.uint16)
+
+
+def _bf16_to_f32(u16):
+    return (u16.astype(np.uint32) << np.uint32(16)).view(np.float32)
+
+
+def encode(vec, dtype=None):
+    """Encode a flat float32 vector as one typed frame.
+
+    ``dtype`` overrides the env-configured send width. f32 payload bytes
+    are the exact ``vec.tobytes()`` of the pre-codec format.
+    """
+    vec = np.ascontiguousarray(np.asarray(vec).reshape(-1), np.float32)
+    dtype = wire_dtype() if dtype is None else dtype
+    if dtype == "bf16":
+        payload = _f32_to_bf16(vec).tobytes()
+        tag = _TAG_BF16
+    elif dtype == "f32":
+        payload = vec.tobytes()
+        tag = _TAG_F32
+    else:
+        raise ValueError(f"unknown wire dtype {dtype!r}")
+    return _HDR.pack(
+        _MAGIC, _VERSION, tag, vec.size, zlib.crc32(payload)
+    ) + payload
+
+
+def decode(buf):
+    """Decode a typed frame back to a float32 vector; raises WireError.
+
+    Validation order matters for the ban path: header shape first (magic,
+    version, dtype tag), then the length/element-count consistency, then
+    the CRC — every random bit flip or truncation of a valid frame fails
+    at least one of these (a payload flip breaks the CRC; a header flip
+    breaks magic/version/tag/length), so corrupted bytes can never reach
+    a GAR (fuzzed in tests/test_wire.py).
+    """
+    if len(buf) < HEADER_NBYTES:
+        raise WireError(
+            f"truncated frame: {len(buf)} bytes is shorter than the "
+            f"{HEADER_NBYTES}-byte header"
+        )
+    magic, ver, tag, elems, crc = _HDR.unpack_from(buf)
+    if magic != _MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if ver != _VERSION:
+        raise WireError(f"unsupported wire version {ver}")
+    if tag not in _ITEMSIZE:
+        raise WireError(f"unknown dtype tag {tag}")
+    payload = buf[HEADER_NBYTES:]
+    if len(payload) != elems * _ITEMSIZE[tag]:
+        raise WireError(
+            f"payload is {len(payload)} bytes but the header promises "
+            f"{elems} elements of {_ITEMSIZE[tag]} bytes"
+        )
+    if zlib.crc32(payload) != crc:
+        raise WireError("payload CRC mismatch")
+    if tag == _TAG_BF16:
+        return _bf16_to_f32(np.frombuffer(payload, np.uint16))
+    return np.frombuffer(payload, np.float32)
+
+
+def frame_nbytes(elems, dtype=None):
+    """Total wire bytes of an ``elems``-element frame at ``dtype`` —
+    the bench/telemetry accounting twin of ``encode``."""
+    dtype = wire_dtype() if dtype is None else dtype
+    return HEADER_NBYTES + int(elems) * (2 if dtype == "bf16" else 4)

@@ -363,6 +363,40 @@ def test_learn_cluster_checkpoint_resume(tmp_path):
         assert summary["steps"] == 10, summary
 
 
+@pytest.mark.parametrize("wdtype", ["f32", "bf16"])
+def test_cluster_wire_dtype_convergence_under_lie(tmp_path, wdtype):
+    """The wire-codec convergence smoke (ISSUE r8 acceptance): the 8-rank
+    deployment (1 PS + 7 workers) converges under a REAL lie-attack
+    process at BOTH wire widths. f32 keeps payload bytes identical to the
+    pre-codec format (trajectory parity); bf16 halves every frame on the
+    wire and the quantization must stay inside what median's f budget
+    absorbs (utils/wire.py docstring — the on-mesh bf16 pipeline already
+    proved the precision is sufficient, PERF.md r3)."""
+    n_w = 7
+    cfg_path, env = _cluster_setup(tmp_path, n_w)
+    env["GARFIELD_WIRE_DTYPE"] = wdtype
+    n_iter = 120
+    extra = (
+        "--fw", "2", "--num_iter", str(n_iter),
+    )
+    ps = _launch("ps:0", cfg_path, env, extra=extra)
+    workers = [
+        _launch(
+            f"worker:{w}", cfg_path, env,
+            extra=extra + (
+                ("--attack", "lie", "--attack_params", '{"cohort": 2}')
+                if w == n_w - 1 else ()
+            ),
+        )
+        for w in range(n_w)
+    ]
+    _assert_ps_converges(
+        ps, workers,
+        f"median did not ride out the lie attacker on {wdtype} wire",
+        steps=n_iter, timeout=500 + 5 * n_iter,
+    )
+
+
 def test_byzantine_worker_process_tolerated(tmp_path):
     """A REAL Byzantine process (not an on-mesh emulation): worker 3 runs
     with --attack reverse (publishes -100x its gradient, byzWorker.py

@@ -151,16 +151,23 @@ def test_gar_bench_smoke():
             assert r["latency_s"] > 0
 
 
-def test_transfer_bench_smoke():
+def test_transfer_bench_smoke(tmp_path):
     from garfield_tpu.apps.benchmarks import transfer_bench
+    from garfield_tpu.telemetry.exporters import validate_jsonl
 
-    rows = transfer_bench.main(["--ds", "100", "--reps", "2"])
+    out = tmp_path / "transfer.json"
+    rows = transfer_bench.main([
+        "--ds", "100", "--reps", "2", "--trials", "2", "--json", str(out),
+    ])
     assert rows
     for r in rows:  # below-noise rows carry no gbit_per_s
         if r["latency_s"] is None:
             assert r.get("below_noise_floor") is True
         else:
             assert r["gbit_per_s"] > 0
+        assert r["trials"] == 2  # min-over-k provenance (gar_bench parity)
+    # Schema-versioned JSONL twin rides --json (gar_bench r7 parity).
+    assert validate_jsonl(tmp_path / "transfer.jsonl") == len(rows)
 
 
 def test_multihost_config_cli(tmp_path):

@@ -1,0 +1,318 @@
+"""Typed wire codec (utils/wire.py) + its cluster integration.
+
+Codec robustness IS Byzantine robustness on the host plane: a Byzantine
+PROCESS controls its wire bytes, so the codec's reject surface (magic /
+version / dtype tag / element count / crc) is the ban evidence the
+quorum paths act on. The fuzz test is the core guarantee: NO corrupted
+frame ever decodes — it gets its sender excluded exactly like the old
+wrong-length frame did.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from garfield_tpu.utils import wire
+
+
+# --- pure codec (no native / jax dependency) --------------------------------
+
+
+def test_f32_roundtrip_exact_and_payload_byte_identical():
+    """f32 wire must keep trajectory parity with the pre-codec format:
+    the payload after the 16-byte header is the exact ``tobytes()``."""
+    rng = np.random.default_rng(0)
+    v = rng.standard_normal(999).astype(np.float32)
+    frame = wire.encode(v, "f32")
+    assert frame[wire.HEADER_NBYTES:] == v.tobytes()
+    assert len(frame) == wire.frame_nbytes(v.size, "f32")
+    out = wire.decode(frame)
+    assert out.dtype == np.float32
+    np.testing.assert_array_equal(out, v)
+
+
+def test_bf16_roundtrip_within_cast_tolerance():
+    rng = np.random.default_rng(1)
+    v = (rng.standard_normal(2048) * 10.0 ** rng.integers(
+        -6, 6, 2048
+    )).astype(np.float32)
+    frame = wire.encode(v, "bf16")
+    assert len(frame) == wire.frame_nbytes(v.size, "bf16")
+    out = wire.decode(frame)
+    rel = np.abs(out - v) / np.maximum(np.abs(v), 1e-30)
+    assert rel.max() <= 2.0 ** -8  # bf16 has 8 mantissa bits
+
+    # Specials survive (the lie attack at cohort=1 publishes NaN — the
+    # reference's emergent behavior must not be laundered by the wire).
+    specials = np.array([np.nan, np.inf, -np.inf, 0.0, -0.0], np.float32)
+    out = wire.decode(wire.encode(specials, "bf16"))
+    assert np.isnan(out[0]) and np.isposinf(out[1]) and np.isneginf(out[2])
+    assert out[3] == 0.0 and out[4] == 0.0
+
+
+def test_bf16_matches_xla_convert():
+    """The host cast must equal XLA's f32->bf16 convert (round-to-nearest-
+    even): a host-decoded gradient is bit-equal to what the on-mesh bf16
+    pipeline would have produced for the same value."""
+    jnp = pytest.importorskip("jax.numpy")
+    rng = np.random.default_rng(2)
+    v = rng.standard_normal(4096).astype(np.float32)
+    host = wire.decode(wire.encode(v, "bf16"))
+    xla = np.asarray(jnp.asarray(v).astype(jnp.bfloat16).astype(jnp.float32))
+    np.testing.assert_array_equal(host, xla)
+
+
+def test_wire_dtype_env(monkeypatch):
+    monkeypatch.delenv("GARFIELD_WIRE_DTYPE", raising=False)
+    assert wire.wire_dtype() == "f32"
+    monkeypatch.setenv("GARFIELD_WIRE_DTYPE", "bf16")
+    assert wire.wire_dtype() == "bf16"
+    v = np.ones(4, np.float32)
+    assert len(wire.encode(v)) == wire.frame_nbytes(4, "bf16")
+    monkeypatch.setenv("GARFIELD_WIRE_DTYPE", "f16")
+    with pytest.raises(ValueError):
+        wire.wire_dtype()
+
+
+def test_fuzz_corrupted_frames_never_decode():
+    """Every single-bit flip and every truncation of a valid frame must
+    raise WireError — corrupted bytes can NEVER reach a GAR. (A payload
+    flip breaks the crc; a header flip breaks magic/version/tag/length;
+    a truncation breaks the length contract.)"""
+    rng = np.random.default_rng(3)
+    v = rng.standard_normal(257).astype(np.float32)
+    for dtype in wire.WIRE_DTYPES:
+        frame = wire.encode(v, dtype)
+        # exhaustive over the header, random over the payload
+        bits = list(range(wire.HEADER_NBYTES * 8)) + list(
+            rng.integers(wire.HEADER_NBYTES * 8, len(frame) * 8, 400)
+        )
+        for bit in bits:
+            ba = bytearray(frame)
+            ba[bit // 8] ^= 1 << (bit % 8)
+            with pytest.raises(wire.WireError):
+                wire.decode(bytes(ba))
+        for cut in list(range(0, wire.HEADER_NBYTES + 2)) + list(
+            rng.integers(0, len(frame), 60)
+        ):
+            with pytest.raises(wire.WireError):
+                wire.decode(frame[:int(cut)])
+        with pytest.raises(wire.WireError):
+            wire.decode(frame + b"x")  # trailing garbage
+    with pytest.raises(wire.WireError):
+        wire.decode(b"")  # the SSMW stop sentinel must not decode
+
+
+# --- exchange integration (native runtime required) -------------------------
+
+pytest.importorskip("garfield_tpu.native")
+from garfield_tpu import native  # noqa: E402
+
+_HAVE_NATIVE = native.load() is not None
+
+needs_native = pytest.mark.skipif(
+    not _HAVE_NATIVE, reason="native runtime unavailable"
+)
+
+
+def _ports(k):
+    socks = [socket.socket() for _ in range(k)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _mesh(n, **kw):
+    from garfield_tpu.utils.exchange import PeerExchange
+
+    hosts = [f"127.0.0.1:{p}" for p in _ports(n)]
+    return [PeerExchange(i, hosts, **kw) for i in range(n)]
+
+
+@needs_native
+def test_cross_dtype_publish_collect():
+    """Mixed-width deployments interoperate: decoding is header-driven,
+    never local-setting-driven — a bf16 sender and an f32 sender land in
+    the same quorum."""
+    rng = np.random.default_rng(4)
+    v0 = rng.standard_normal(64).astype(np.float32)
+    v1 = rng.standard_normal(64).astype(np.float32)
+
+    def tf(idx, payload):
+        return wire.decode(payload)
+
+    peers = _mesh(2)
+    try:
+        peers[0].publish(3, wire.encode(v0, "f32"))
+        peers[1].publish(3, wire.encode(v1, "bf16"))
+        for p in peers:
+            got = p.collect(3, q=2, timeout_ms=10_000, transform=tf)
+            np.testing.assert_array_equal(got[0], v0)
+            np.testing.assert_array_equal(
+                got[1], wire.decode(wire.encode(v1, "bf16"))
+            )
+    finally:
+        for p in peers:
+            p.close()
+
+
+@needs_native
+def test_transform_error_is_stored_not_raised():
+    """A transform that raises (codec reject) must surface as the peer's
+    stored result — attributed ban evidence, not a missing-peer timeout."""
+    from garfield_tpu.apps.cluster import _frame_transform
+
+    peers = _mesh(2)
+    try:
+        tf = _frame_transform((8, 0))
+        frame = bytearray(wire.encode(np.ones(8, np.float32), "f32"))
+        frame[-1] ^= 0x40  # payload bit flip -> crc reject
+        peers[1].publish(0, bytes(frame))
+        peers[0].publish(0, wire.encode(np.zeros(8, np.float32), "f32"))
+        got = peers[0].collect(0, q=2, timeout_ms=10_000, transform=tf)
+        assert isinstance(got[1], wire.WireError)
+        assert got[1].nbytes == len(frame)
+        head, tail = got[0]
+        np.testing.assert_array_equal(np.asarray(head), np.zeros(8))
+        assert tail.size == 0
+    finally:
+        for p in peers:
+            p.close()
+
+
+@needs_native
+def test_gradient_quorum_bans_corrupt_codec_frames():
+    """The malformed-frame ban path, end to end: random bit-flipped and
+    truncated codec payloads never reach the aggregation and get their
+    sender excluded from all future quorums — exactly like the old
+    wrong-length frame (ISSUE r8 satellite)."""
+    from garfield_tpu.apps.cluster import _gradient_quorum
+    from garfield_tpu.telemetry import hub as tele_hub
+
+    d = 32
+    rng = np.random.default_rng(5)
+    honest = rng.standard_normal(d).astype(np.float32)
+    hub = tele_hub.MetricsHub()
+    prev = tele_hub.install(hub)
+    peers = _mesh(3)  # 0 = PS, 1 = honest worker, 2 = Byzantine bytes
+    try:
+        for trial, corrupt in enumerate([
+            b"\x00" * 10,                                   # garbage
+            wire.encode(honest, "f32")[: wire.HEADER_NBYTES + 7],  # trunc
+            bytes([b ^ (1 << rng.integers(8)) if i == 20 else b
+                   for i, b in enumerate(wire.encode(honest, "bf16"))]),
+        ]):
+            step = trial
+            peers[2].publish(step, corrupt, to=[0])
+            # The honest frame arrives LATE so the q=1 quorum closes on
+            # the corrupt frame first and the ban path must re-collect.
+            t = threading.Timer(
+                0.3, lambda s=step: peers[1].publish(
+                    s, wire.encode(honest, "f32"), to=[0]
+                )
+            )
+            t.start()
+            deadline = time.time() + 10
+            while peers[0]._mb.version(2) < trial + 1 and time.time() < deadline:
+                time.sleep(0.02)
+            got, good = _gradient_quorum(
+                peers[0], step, 1, [1, 2], (d, 0),
+                republish=lambda: None, timeout_ms=10_000, who="test-ps",
+            )
+            t.join()
+            # The corrupt frame never enters the result; rank 2 is banned.
+            assert good == [1]
+            assert set(got) == {1}
+            np.testing.assert_array_equal(np.asarray(got[1][0]), honest)
+        events = [r for r in hub.records()
+                  if r.get("event") == "quorum_exclusion"]
+        assert events and all(e["rank"] == 2 for e in events)
+    finally:
+        tele_hub.uninstall()
+        if prev is not None:
+            tele_hub.install(prev)
+        for p in peers:
+            p.close()
+
+
+@needs_native
+def test_send_queue_drop_event_emitted():
+    """Publisher-side backpressure is no longer silent: overflowing a
+    hung receiver's bounded sender queue emits ``send_queue_drop``
+    (ISSUE r8 satellite — mirrors the receive-side ``plane_drop``)."""
+    from garfield_tpu.telemetry import hub as tele_hub
+    from garfield_tpu.utils.exchange import PeerExchange
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    conns = []
+
+    def sink():  # accepts, never reads: a hung (not crashed) receiver
+        try:
+            while True:
+                conn, _ = srv.accept()
+                conns.append(conn)
+        except OSError:
+            pass
+
+    threading.Thread(target=sink, daemon=True).start()
+    p0 = _ports(1)[0]
+    hosts = [f"127.0.0.1:{p0}", f"127.0.0.1:{srv.getsockname()[1]}"]
+    hub = tele_hub.MetricsHub()
+    prev = tele_hub.install(hub)
+    ex = PeerExchange(0, hosts, send_queue_frames=1, send_timeout_ms=2_000)
+    try:
+        big = b"\x00" * (8 << 20)  # 8 MB: sendall blocks on TCP buffers
+        deadline = time.time() + 20
+        while not hub.wire_counters()["send_queue_drops"]:
+            ex.publish(0, big, to=[1])
+            assert time.time() < deadline, "no send_queue_drop emitted"
+            time.sleep(0.05)
+        drops = [r for r in hub.records()
+                 if r.get("event") == "send_queue_drop"]
+        assert drops and drops[0]["peer"] == 1
+    finally:
+        tele_hub.uninstall()
+        if prev is not None:
+            tele_hub.install(prev)
+        ex.close()
+        srv.close()
+        for c in conns:
+            c.close()
+
+
+@needs_native
+@pytest.mark.slow
+def test_exchange_bench_multiprocess():
+    """The committed-record generator works end to end: a tiny
+    multi-process micro grid produces parseable JSON + a schema-valid
+    JSONL twin, and bf16 measures >= 1.8x fewer wire bytes/step than f32
+    (the ISSUE r8 acceptance bar)."""
+    import json
+    import tempfile
+
+    from garfield_tpu.apps.benchmarks import exchange_bench
+    from garfield_tpu.telemetry.exporters import validate_jsonl
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "exch.json")
+        rows = exchange_bench.main([
+            "--ns", "2", "--ds", "4096", "--wire", "f32", "bf16",
+            "--rounds", "4", "--trials", "1", "--json", out,
+        ])
+        assert validate_jsonl(os.path.splitext(out)[0] + ".jsonl") == 2
+        committed = json.load(open(out))
+        assert committed == rows
+        by_wire = {r["wire"]: r for r in rows}
+        ratio = (by_wire["f32"]["wire_bytes_per_step"]
+                 / by_wire["bf16"]["wire_bytes_per_step"])
+        assert ratio >= 1.8, ratio
+        for r in rows:
+            assert r["round_s"] is None or r["round_s"] > 0
