@@ -26,7 +26,10 @@ chip's run-to-run variance spikes 1.5-4x for stretches, so the reported
 value is the BEST trial: closest to the machine's actual capability and
 the standard guard against co-tenant noise),
 GARFIELD_BENCH_F32_GAR (set to disable the default bf16 aggregation
-pipeline on TPU and run the GAR phase at full width).
+pipeline on TPU and run the GAR phase at full width),
+GARFIELD_BENCH_CHUNK (K steps scanned on device per dispatch via
+core.make_chunked_step; per-step time = chunk_time / K; the JSON line
+carries chunk_steps so BENCH rows stay attributable).
 
 The tunneled backend can drop a single HTTP response mid-compile
 ("remote_compile: read body: response body closed" — see BENCH_r02.json);
@@ -56,13 +59,16 @@ _PEAK_BF16 = {
 }
 
 
-def _step_flops(compiled, axis_size, num_workers, batch):
+def _step_flops(compiled, axis_size, num_workers, batch, chunk=1):
     """Global FLOPs of one train step (XLA cost model; analytic fallback).
 
     ``cost_analysis`` reports the partitioned per-device module, so the XLA
-    number is scaled by ``axis_size`` to a global count. The fallback is the
-    standard CIFAR-style ResNet-18 count: ~0.557 GMACs = 1.11 GFLOPs forward
-    per 32x32 image, x3 for fwd+bwd, x total images (already global).
+    number is scaled by ``axis_size`` to a global count — and divided by
+    ``chunk`` when the compiled module is a K-step chunked program (the
+    per-step quantity is what MFU needs). The fallback is the standard
+    CIFAR-style ResNet-18 count: ~0.557 GMACs = 1.11 GFLOPs forward per
+    32x32 image, x3 for fwd+bwd, x total images (already global, already
+    per step).
     """
     try:
         cost = compiled.cost_analysis()
@@ -70,28 +76,49 @@ def _step_flops(compiled, axis_size, num_workers, batch):
             cost = cost[0]
         flops = float(cost.get("flops", 0.0))
         if flops > 0:
-            return flops * axis_size
+            return flops * axis_size / chunk
     except Exception:
         pass
     return 3 * 1.11e9 * num_workers * batch
 
 
-def _measure(step_fn, init_fn, x, y, steps):
+def _measure(step_fn, init_fn, x, y, steps, chunk=1):
     """Compile, warm up, and time one configuration. Raises on any backend
-    failure; the caller retries. Returns (dt_per_step, compiled)."""
+    failure; the caller retries. Returns (dt_per_step, compiled).
+
+    ``chunk > 1`` (GARFIELD_BENCH_CHUNK) times the CHUNKED program
+    (core.make_chunked_step): each dispatch scans ``chunk`` steps on
+    device, the readback syncs once per chunk, and the honest per-step
+    time is chunk_time / chunk. The paired-reps estimator composes
+    naturally — a chunk IS a dependency chain, so the k-dispatch chain it
+    times is a k*chunk-step chain and the constant sync cost still
+    cancels in the difference (PERF.md "Timing methodology")."""
+    import numpy as np
+
+    from garfield_tpu.parallel import core as core_lib
     from garfield_tpu.utils import profiling
 
     state = init_fn(jax.random.PRNGKey(1234), x[0])
 
     # AOT-compile once: the same executable serves warmup, timing, and the
     # cost-analysis read — no second compile after timing finishes.
-    compiled = step_fn.lower(state, x, y).compile()
+    if chunk > 1:
+        # One-slot batch axis: the bench reuses a single synthetic batch,
+        # so the on-device index b = (i0 + k) % 1 always selects it.
+        xs, ys = x[:, None], y[:, None]
+        chunked = core_lib.make_chunked_step(step_fn, chunk, 1)
+        compiled = chunked.lower(state, xs, ys, jnp.int32(0)).compile()
+        call = lambda st: compiled(st, xs, ys, jnp.int32(0))
+    else:
+        compiled = step_fn.lower(state, x, y).compile()
+        call = lambda st: compiled(st, x, y)
 
     for _ in range(3):  # warmup: stabilize clocks
-        state, metrics = compiled(state, x, y)
-    float(metrics["loss"])  # host readback: drains the queue (on tunneled
-    # backends block_until_ready can return before the device finishes; a
-    # readback is the only reliable sync, at a constant queue-flush cost)
+        state, metrics = call(state)
+    # host readback: drains the queue (on tunneled backends
+    # block_until_ready can return before the device finishes; a readback
+    # is the only reliable sync, at a constant queue-flush cost)
+    float(np.asarray(metrics["loss"]).reshape(-1)[-1])
 
     state_box = [state]
 
@@ -99,8 +126,8 @@ def _measure(step_fn, init_fn, x, y, steps):
         state = state_box[0]
         t0 = time.perf_counter()
         for _ in range(k):
-            state, metrics = compiled(state, x, y)
-        float(metrics["loss"])
+            state, metrics = call(state)
+        float(np.asarray(metrics["loss"]).reshape(-1)[-1])
         state_box[0] = state
         return time.perf_counter() - t0
 
@@ -114,7 +141,7 @@ def _measure(step_fn, init_fn, x, y, steps):
         # sync cost, so it UNDER-reports throughput — conservative, never
         # the ~1/floor fantasy number the old clamp could produce.
         dt = timed(steps) / steps
-    return dt, compiled
+    return dt / chunk, compiled
 
 
 def _emit_jsonl(fields):
@@ -138,6 +165,7 @@ def _emit_jsonl(fields):
                     unit=fields.get("unit"),
                     vs_baseline=fields.get("vs_baseline"),
                     mfu=fields.get("mfu"),
+                    chunk_steps=fields.get("chunk_steps"),
                     error=fields.get("error"),
                     t=time.time(),
                 ),
@@ -195,6 +223,9 @@ def _main_impl():
         attack_name = None
     batch = int(os.environ.get("GARFIELD_BENCH_BATCH", 25))
     steps = max(1, int(os.environ.get("GARFIELD_BENCH_STEPS", 20)))
+    # On-device step chunking (core.make_chunked_step): K steps per
+    # dispatch, per-step time = chunk_time / K. 1 = the per-step program.
+    chunk = max(1, int(os.environ.get("GARFIELD_BENCH_CHUNK", 1)))
 
     platform = jax.devices()[0].platform
     # bf16 compute routes conv/matmul onto the MXU; params stay f32.
@@ -244,7 +275,9 @@ def _main_impl():
         trial_dt = None
         for attempt in range(attempts):
             try:
-                trial_dt, compiled = _measure(step_fn, init_fn, x, y, steps)
+                trial_dt, compiled = _measure(
+                    step_fn, init_fn, x, y, steps, chunk=chunk
+                )
                 break
             except Exception as e:
                 # Only transient tunnel/transport failures earn a retry;
@@ -281,7 +314,7 @@ def _main_impl():
         dt = trial_dt if dt is None else min(dt, trial_dt)
 
     steps_per_sec_per_chip = 1.0 / dt / axis_size
-    flops = _step_flops(compiled, axis_size, num_workers, batch)
+    flops = _step_flops(compiled, axis_size, num_workers, batch, chunk=chunk)
     peak = _PEAK_BF16.get(jax.devices()[0].device_kind)
     mfu = (flops / dt / (peak * axis_size)) if peak else None
     baseline = None
@@ -316,6 +349,9 @@ def _main_impl():
         "unit": "steps/s/chip",
         "vs_baseline": round(vs, 4) if vs is not None else None,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        # Attribution for BENCH_r06+ rows: how many steps each dispatch
+        # scanned on device (1 = the classic per-step program).
+        "chunk_steps": chunk,
     }
     print(json.dumps(result))
     _emit_jsonl(result)

@@ -14,6 +14,7 @@ trainer.py:227-229), and new-capability flags: --checkpoint_dir/--resume
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -24,7 +25,7 @@ import numpy as np
 from .. import data as data_lib, models as models_lib, parallel
 from ..utils import checkpoint as ckpt_lib, profiling, selectors, tools
 
-__all__ = ["base_parser", "build_ingredients", "train"]
+__all__ = ["base_parser", "build_ingredients", "chunk_length", "train"]
 
 
 def base_parser(description, *, default_model="convnet", default_loss="nll"):
@@ -104,6 +105,16 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
       help="Number of simulated hosts the worker slots fold onto for "
            "--fault_crashes (default: one host per worker slot).")
     # --- new capabilities (absent in the reference) ---
+    a("--chunk_steps", type=int, default=None,
+      help="On-device step chunking (docs/DESIGN.md §12): lax.scan K "
+           "training steps inside ONE jitted dispatch "
+           "(parallel/core.make_chunked_step), so K-1 of every K host "
+           "dispatches disappear and XLA overlaps step i's optimizer/GAR "
+           "tail with step i+1's forward. Chunks auto-clip at every loop "
+           "boundary (eval points, checkpoint saves, crash-schedule "
+           "re-jits, the profiled step, end of run), and trajectories are "
+           "bitwise equal to per-step execution. Default: env "
+           "GARFIELD_CHUNK_STEPS, else 1 (per-step).")
     a("--telemetry", type=str, nargs="?", const="telemetry", default=None,
       metavar="DIR",
       help="Enable the telemetry plane (docs/TELEMETRY.md): in-graph GAR "
@@ -254,6 +265,44 @@ def _crash_schedule(args, num_slots, declared_f):
     return multihost.FaultSchedule(num_hosts, crashes=crashes)
 
 
+def chunk_length(i, *, chunk, num_iter, acc_freq=0, checkpoint_freq=0,
+                 crash_steps=(), profile_step=None):
+    """Steps the chunk starting at step ``i`` may cover (>= 1, <= chunk).
+
+    A chunked dispatch (``--chunk_steps``) is opaque to the host until it
+    returns, so every host-side action the per-step loop interleaves must
+    land exactly on a chunk boundary. The clip rules (one per boundary
+    kind, each pinned by a test in tests/test_chunked.py):
+
+      - **eval**: accuracy runs after step j when ``j % acc_freq == 0``,
+        so the chunk must end at ``j + 1`` for the first such j >= i;
+      - **checkpoint**: a save fires after step j when ``(j + 1) %
+        checkpoint_freq == 0``, so the chunk must end on the next multiple
+        of ``checkpoint_freq`` above i;
+      - **crash**: a ``--fault_crashes`` event at step s re-jits the step
+        with the new Byzantine mask, so no chunk may span s;
+      - **profile**: the profiled step runs as its own single-step
+        dispatch so the trace holds exactly one step program;
+      - **end of run**: never past ``num_iter``.
+    """
+    end = min(i + chunk, num_iter)
+    if acc_freq:
+        # First eval point j >= i (j % acc_freq == 0); eval needs the
+        # post-step-j state, so the chunk may include j but nothing after.
+        end = min(end, i + (-i % acc_freq) + 1)
+    if checkpoint_freq:
+        end = min(end, i + checkpoint_freq - i % checkpoint_freq)
+    for at in crash_steps:
+        if at > i:
+            end = min(end, at)
+    if profile_step is not None:
+        if i < profile_step:
+            end = min(end, profile_step)
+        elif i == profile_step:
+            end = min(end, i + 1)
+    return max(1, end - i)
+
+
 def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     """The reference training loop (Aggregathor/trainer.py:226-264), SPMD:
     batch selection by step index (batch i = train_set[i % len],
@@ -293,8 +342,6 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     # the same stream as the per-step taps.
     tele_hub = tele_exp = None
     if getattr(args, "telemetry", None):
-        import os
-
         from ..telemetry import exporters as tele_fmt, hub as tele_hub_lib
 
         taps_supported = "telemetry" in trainer_params
@@ -355,24 +402,37 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
             module, loss_fn, optimizer, args.gar, mesh=mesh, **kwargs
         )
 
-    init_fn, step_fn, eval_fn = build(0)
+    chunk = args.chunk_steps
+    if chunk is None:
+        chunk = int(os.environ.get("GARFIELD_CHUNK_STEPS") or 1)
+    if chunk < 1:
+        raise SystemExit(f"--chunk_steps must be >= 1, got {chunk}")
+
+    # Resume target BEFORE the first build: the rebuilt program's num_iter
+    # hint (the unroll-amortization decision, core.slot_path_decision) must
+    # see the REMAINING steps, not the original total — a resumed run only
+    # serves num_iter - start_iter steps from here.
+    ckpt = None
+    start_iter = 0
+    if args.checkpoint_dir:
+        ckpt = ckpt_lib.Checkpointer(args.checkpoint_dir)
+        if args.resume and ckpt.latest_step() is not None:
+            start_iter = int(ckpt.latest_step())
+
+    init_fn, step_fn, eval_fn = build(start_iter)
 
     xs = jax.device_put(jnp.asarray(xs_np), step_fn.batch_sharding)
     ys = jax.device_put(jnp.asarray(ys_np), step_fn.batch_sharding)
     key = jax.random.PRNGKey(args.seed)
     state = init_fn(key, xs_np[0, 0])
 
-    ckpt = None
-    start_iter = 0
-    if args.checkpoint_dir:
-        ckpt = ckpt_lib.Checkpointer(args.checkpoint_dir)
-        if args.resume and ckpt.latest_step() is not None:
-            state = jax.device_put(
-                ckpt.restore(jax.tree.map(np.asarray, state)),
-                jax.tree.map(lambda l: l.sharding, state),
-            )
-            start_iter = int(np.asarray(state.step))
-            tools.info(f"[{tag}] resumed from step {start_iter}")
+    if ckpt is not None and start_iter:
+        state = jax.device_put(
+            ckpt.restore(jax.tree.map(np.asarray, state)),
+            jax.tree.map(lambda l: l.sharding, state),
+        )
+        start_iter = int(np.asarray(state.step))
+        tools.info(f"[{tag}] resumed from step {start_iter}")
 
     timer = profiling.StepTimer()
     d = int(sum(np.prod(l.shape) for l in jax.tree.leaves(state.params)))
@@ -380,12 +440,26 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     metrics = {}
 
     cur_mask = sched.byz_mask(start_iter, num_slots) if sched else None
-    if sched is not None and start_iter:
-        _, step_fn, _ = build(start_iter)
     eval_threads = []
 
+    # Chunked dispatch programs, one per distinct (clipped) chunk length —
+    # boundary clipping produces a handful of lengths at most. Invalidated
+    # whenever the step itself is rebuilt (crash-schedule re-jit).
+    crash_steps = sorted(set(sched.crashes.values())) if sched else []
+    profile_step = (start_iter + 5) if args.profile_dir else None
+    chunk_fns = {}
+
+    def chunked_for(k):
+        fn = chunk_fns.get(k)
+        if fn is None:
+            fn = chunk_fns[k] = parallel.core.make_chunked_step(
+                step_fn, k, num_batches
+            )
+        return fn
+
     t_train = time.time()
-    for i in range(start_iter, args.num_iter):
+    i = start_iter
+    while i < args.num_iter:
         if sched is not None:
             mask = sched.byz_mask(i, num_slots)
             if (mask != cur_mask).any():
@@ -395,20 +469,43 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                     f"{np.flatnonzero(mask).tolist()}; re-jitting step"
                 )
                 # Only the step depends on the mask — keep eval_fn's (and
-                # init_fn's) compiled programs.
+                # init_fn's) compiled programs. Chunk programs scan the
+                # step body, so they are rebuilt from the new step too.
                 _, step_fn, _ = build(i)
-        b = i % num_batches
-        profiling_this = args.profile_dir and i == start_iter + 5
+                chunk_fns.clear()
+        k = chunk_length(
+            i, chunk=chunk, num_iter=args.num_iter, acc_freq=args.acc_freq,
+            checkpoint_freq=(args.checkpoint_freq if ckpt else 0),
+            crash_steps=crash_steps, profile_step=profile_step,
+        )
+        profiling_this = profile_step is not None and i == profile_step
         with profiling.trace(args.profile_dir if profiling_this else None):
-            if args.bench:
-                # Honest per-step numbers require a device sync; without
-                # --bench we leave dispatch asynchronous (faster) and report
-                # only whole-run throughput below.
-                with timer.step(block_on=None):
+            if k == 1:
+                b = i % num_batches
+                if args.bench:
+                    # Honest per-step numbers require a device sync;
+                    # without --bench we leave dispatch asynchronous
+                    # (faster) and report only whole-run throughput below.
+                    with timer.step(block_on=None):
+                        state, metrics = step_fn(state, xs[:, b], ys[:, b])
+                        jax.block_until_ready(metrics["loss"])
+                else:
                     state, metrics = step_fn(state, xs[:, b], ys[:, b])
-                    jax.block_until_ready(metrics["loss"])
             else:
-                state, metrics = step_fn(state, xs[:, b], ys[:, b])
+                # One dispatch for k on-device steps; metrics leaves carry
+                # a leading k axis. A per-step sync here would serialize
+                # the chunk back into per-step dispatches and defeat it —
+                # bench mode syncs ONCE per chunk and reports the honest
+                # per-step time chunk_time / k (PERF.md methodology).
+                cfn = chunked_for(k)
+                if args.bench:
+                    t0 = time.perf_counter()
+                    state, metrics = cfn(state, xs, ys, np.int32(i))
+                    jax.block_until_ready(metrics["loss"])
+                    timer.record_chunk(time.perf_counter() - t0, k)
+                else:
+                    state, metrics = cfn(state, xs, ys, np.int32(i))
+        end = i + k
         if args.bench:
             byz_bytes = profiling.collective_bytes(
                 tag, num_workers=num_slots, d=d,
@@ -417,32 +514,56 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                     step_fn.mesh.axis_names[-1]
                 ],
             )
-            print(
-                f"Training step {i} takes {timer.last():.4f} seconds",
-                flush=True,
-            )
+            if k == 1:
+                print(
+                    f"Training step {i} takes {timer.last():.4f} seconds",
+                    flush=True,
+                )
+            else:
+                print(
+                    f"Training steps {i}-{end - 1} take "
+                    f"{timer.last() * k:.4f} seconds "
+                    f"({timer.last():.4f} s/step, chunked x{k})",
+                    flush=True,
+                )
             print(
                 "Consumed bandwidth in this iteration: "
                 f"{profiling.convert_to_gbit(byz_bytes):.4f} Gbits",
                 flush=True,
             )
         if tele_hub is not None:
-            # One host readback per step (the documented telemetry sync
-            # cost): the tap bundle is tiny — (n,) vectors + two scalars.
-            tele_exp.write(tele_hub.record_step(
-                i,
-                loss=float(metrics["loss"]),
-                tap=metrics.get("tap"),
-                step_time_s=timer.last() if args.bench else None,
-            ))
+            # One host readback per CHUNK (the documented telemetry sync
+            # cost), fanned back out into k per-step records — the hub
+            # ingests the same stream as the per-step loop.
+            host_metrics = jax.device_get(metrics)
+            for j in range(k):
+                m_j = (
+                    host_metrics if k == 1
+                    else jax.tree.map(lambda l: l[j], host_metrics)
+                )
+                tele_exp.write(tele_hub.record_step(
+                    i + j,
+                    loss=float(m_j["loss"]),
+                    tap=m_j.get("tap"),
+                    step_time_s=timer.last() if args.bench else None,
+                ))
         if args.log:
-            print(f"Loss {i}: {float(metrics['loss']):.6f}", flush=True)
-        if args.acc_freq and i % args.acc_freq == 0:
-            # Stamp Time at the eval REQUEST, not at the (possibly much
-            # later) async readback, so accuracy-vs-time stays meaningful.
+            losses = np.asarray(metrics["loss"]).reshape(-1)
+            for j in range(k):
+                print(
+                    f"Loss {i + j}: {float(losses[j if k > 1 else -1]):.6f}",
+                    flush=True,
+                )
+        last = end - 1
+        if args.acc_freq and last % args.acc_freq == 0:
+            # Boundary clipping guarantees an eval point is always the
+            # chunk's LAST step, so the state here is the post-step-`last`
+            # state the per-step loop evaluated. Stamp Time at the eval
+            # REQUEST, not at the (possibly much later) async readback,
+            # so accuracy-vs-time stays meaningful.
             t_req = time.time() - t_start
 
-            def _report(acc, i=i, t_req=t_req):
+            def _report(acc, i=last, t_req=t_req):
                 print(
                     f"Epoch: {i / max(iters_per_epoch, 1):.2f} "
                     f"Accuracy: {acc:.4f} Time: {t_req:.1f}",
@@ -465,8 +586,9 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                     on_done=_report,
                     after=eval_threads[-1] if eval_threads else None,
                 ))
-        if ckpt and args.checkpoint_freq and (i + 1) % args.checkpoint_freq == 0:
-            ckpt.save(i + 1, jax.tree.map(np.asarray, state))
+        if ckpt and args.checkpoint_freq and end % args.checkpoint_freq == 0:
+            ckpt.save(end, jax.tree.map(np.asarray, state))
+        i = end
 
     jax.block_until_ready(state.step)  # drain async dispatch for honest wall
     train_wall = time.time() - t_train
@@ -478,7 +600,12 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     acc = parallel.compute_accuracy(state, eval_fn, test_batches, binary=binary)
     summary = {
         "final_accuracy": acc,
-        "final_loss": float(metrics["loss"]) if metrics else None,
+        # The last dispatch may have been a chunk: its loss carries a
+        # leading chunk axis; the final loss is the last scan step's.
+        "final_loss": (
+            float(np.asarray(metrics["loss"]).reshape(-1)[-1])
+            if metrics else None
+        ),
         "wall_s": time.time() - t_start,
         "train_wall_s": train_wall,
         "steps_per_sec": steps_done / train_wall if train_wall > 0 else None,
@@ -486,8 +613,6 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     }
     print(json.dumps({"tag": tag, **summary}), flush=True)
     if tele_hub is not None:
-        import os
-
         from ..telemetry import exporters as tele_fmt, hub as tele_hub_lib
 
         tele_exp.write(tele_hub.summary())

@@ -472,4 +472,9 @@ def make_trainer(
 
     step_fn.mesh = mesh
     step_fn.batch_sharding = shard_w
+    # The un-jitted shard_map body + this jit's output shardings, consumed
+    # by core.make_chunked_step so a K-step chunk scans the SAME program
+    # body instead of nesting jits (whose inner donation would be dropped).
+    step_fn.inner = sharded_step
+    step_fn.out_shardings = (repl, repl)
     return init_fn, step_fn, eval_fn

@@ -29,6 +29,7 @@ from jax.flatten_util import ravel_pytree
 __all__ = [
     "TrainState",
     "make_worker_fns",
+    "make_chunked_step",
     "flatten_rows",
     "unflatten_like",
     "subset_indices",
@@ -200,6 +201,110 @@ def step_donation():
     if forced in ("0", "1"):
         return (0,) if forced == "1" else ()
     return () if jax.default_backend() == "cpu" else (0,)
+
+
+def chunk_unroll(chunk_steps):
+    """Scan unroll factor for ``make_chunked_step``: the FULL chunk on
+    XLA:CPU (the rolled while loop pins conv layouts at the loop boundary
+    and per-iteration relayouts invert the chunk win — measured 2.6x
+    WORSE than per-step on convnet/mnist, PERF.md r9), the rolled loop
+    (factor 1) on device backends. ``GARFIELD_CHUNK_UNROLL=<factor>``
+    forces a factor: 1 = rolled, >= chunk_steps = fully unrolled,
+    in between = partial."""
+    forced = _os.environ.get("GARFIELD_CHUNK_UNROLL", "").strip()
+    if forced:
+        return max(1, int(forced))
+    return chunk_steps if jax.default_backend() == "cpu" else 1
+
+
+def make_chunked_step(step_fn, chunk_steps, num_batches, unroll=None):
+    """Fuse ``chunk_steps`` training steps into ONE jitted dispatch.
+
+    The per-step driver loop (apps/common.py) pays one Python dispatch and
+    one host round-trip per training step, so XLA can never overlap step
+    i's optimizer/GAR tail with step i+1's forward — the schedule-level
+    gap every perf round since r2 has pointed at (PERF.md "Known
+    frontier"). This wraps any topology's step in a ``jax.lax.scan`` over
+    K on-device batch indices: K-1 of every K host dispatches disappear
+    and the whole chunk is one XLA program with cross-step overlap.
+
+    ``step_fn`` is a topology step from ``make_trainer`` (its un-jitted
+    ``shard_map`` body is consumed via the ``inner`` attribute the
+    topologies attach, so the scan body is not re-wrapped in a nested
+    jit). Returns
+
+        ``chunked(state, xs, ys, i0) -> (state, metrics)``
+
+    where ``xs``/``ys`` are the FULL device-resident batch stacks with a
+    ``num_batches`` axis at position 1 (the app loop's ``(slots, B, ...)``
+    layout), ``i0`` is the global step index of the chunk's first step
+    (traced, so one compiled program serves every chunk of this length),
+    and each metrics leaf gains a leading ``chunk_steps`` axis — K losses
+    (and K fixed-shape telemetry ``TapBundle``s, when taps are on) per
+    dispatch, which the host loop fans back out into per-step records.
+
+    Trajectory semantics are EXACTLY the per-step loop's:
+
+      - the batch index is computed on device, ``b = (i0 + k) %
+        num_batches`` — the same ``i % num_batches`` the host loop uses;
+      - the ``TrainState`` is the scan carry (params, optimizer state,
+        ``gar_state`` stateful-rule centers, ``worker_mom``, step
+        counter), so stateful rules carry across scan iterations exactly
+        as across dispatches;
+      - per-step RNG needs no extra plumbing: every topology derives its
+        attack/subset/dropout keys by ``fold_in(state.rng, state.step)``
+        and ``step`` advances in the carry, so scan iteration k uses the
+        bitwise-same keys the per-step loop used at step ``i0 + k``
+        (asserted bitwise in tests/test_chunked.py).
+
+    Donation follows ``step_donation()``: the carried TrainState is
+    donated on real device backends, while the batch stacks (args 1-2)
+    are never donated — they are reused by every chunk.
+
+    ``unroll`` is the scan unroll factor (None = backend-aware default,
+    see ``chunk_unroll``): XLA:CPU pins operand layouts at the while-loop
+    boundary, so conv bodies inside a ROLLED scan pay per-iteration
+    relayouts that measurably invert the chunk win (convnet/mnist
+    measured 31 -> 80 ms/step rolled, 31 -> 24.5 ms/step fully unrolled,
+    PERF.md r9); full unroll restores layout freedom and the cross-step
+    overlap at a ~K-times compile cost — the same compile-vs-steady-state
+    trade the slot unroll already navigates. Device backends keep the
+    rolled loop (compile time at ResNet scale is precious; the chip A/B
+    is the next live-backend task).
+    """
+    if chunk_steps < 1:
+        raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
+    if num_batches < 1:
+        raise ValueError(f"num_batches must be >= 1, got {num_batches}")
+    inner = getattr(step_fn, "inner", step_fn)
+    out_shardings = getattr(step_fn, "out_shardings", None)
+    if unroll is None:
+        unroll = chunk_unroll(chunk_steps)
+    unroll = max(1, min(int(unroll), chunk_steps))
+
+    def scan_steps(state, xs, ys, i0):
+        def body(st, k):
+            b = jax.lax.rem(i0 + k, jnp.int32(num_batches))
+            x = jax.lax.dynamic_index_in_dim(xs, b, 1, keepdims=False)
+            y = jax.lax.dynamic_index_in_dim(ys, b, 1, keepdims=False)
+            return inner(st, x, y)
+
+        return jax.lax.scan(
+            body, state, jnp.arange(chunk_steps, dtype=jnp.int32),
+            unroll=unroll,
+        )
+
+    import functools
+
+    jit_kwargs = {}
+    if out_shardings is not None:
+        jit_kwargs["out_shardings"] = out_shardings
+    chunked = functools.partial(jax.jit, donate_argnums=step_donation(),
+                                **jit_kwargs)(scan_steps)
+    chunked.mesh = getattr(step_fn, "mesh", None)
+    chunked.batch_sharding = getattr(step_fn, "batch_sharding", None)
+    chunked.chunk_steps = chunk_steps
+    return chunked
 
 
 def slot_path_decision(slots, num_iter=None, fused_available=False):

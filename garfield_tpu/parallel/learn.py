@@ -651,4 +651,7 @@ def make_trainer(
 
     step_fn.mesh = mesh
     step_fn.batch_sharding = node_sharding
+    # Chunking hook (core.make_chunked_step): scan the shard_map body
+    # directly; shardings propagate as in the per-step jit (none pinned).
+    step_fn.inner = sharded_step
     return init_fn, step_fn, eval_fn

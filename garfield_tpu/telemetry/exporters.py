@@ -32,7 +32,11 @@ __all__ = [
 ]
 
 SCHEMA = "garfield-telemetry"
-SCHEMA_VERSION = 1
+# v2 (round 9): summary.step_time gained p50_s/p95_s/p99_s tail
+# percentiles (the chunked-dispatch win lives in the tail, not the mean)
+# and bench records gained the chunk_steps attribution field. v1 records
+# still validate — consumers key on field presence, not version.
+SCHEMA_VERSION = 2
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
          "transfer_bench", "exchange_bench")
@@ -140,12 +144,30 @@ def validate_record(rec):
                 _fail(f"summary.{key} must be a non-negative int, got {val!r}")
         if rec.get("suspicion") is not None:
             _check_float_list("summary", "suspicion", rec["suspicion"])
+        st = rec.get("step_time")
+        if st is not None:
+            if not isinstance(st, dict):
+                _fail(f"summary.step_time must be an object, got {st!r}")
+            for key in ("mean_s", "p50_s", "p95_s", "p99_s"):
+                val = st.get(key)
+                # v1 summaries carry only mean_s; v2 adds the percentiles
+                # — whichever are present must be numbers.
+                if key in st and not _is_num(val):
+                    _fail(
+                        f"summary.step_time.{key} must be a number, "
+                        f"got {val!r}"
+                    )
     elif kind == "bench":
         if not isinstance(rec.get("metric"), str):
             _fail(f"bench.metric must be a string, got {rec.get('metric')!r}")
         val = rec.get("value")
         if val is not None and not _is_num(val):
             _fail(f"bench.value must be a number or null, got {val!r}")
+        cs = rec.get("chunk_steps")
+        if cs is not None and (
+            not isinstance(cs, int) or isinstance(cs, bool) or cs < 1
+        ):
+            _fail(f"bench.chunk_steps must be a positive int, got {cs!r}")
     elif kind == "gar_bench":
         if not isinstance(rec.get("gar"), str):
             _fail(f"gar_bench.gar must be a string, got {rec.get('gar')!r}")
@@ -246,6 +268,13 @@ def prometheus_text(hub):
     metric("garfield_step_time_seconds", "gauge",
            "Mean recorded step wall time.",
            [({}, None if st is None else st["mean_s"])])
+    if st is not None:
+        metric("garfield_step_time_seconds_quantile", "gauge",
+               "Step wall-time percentiles from the hub's recorded step "
+               "times (the dispatch-tail signal --chunk_steps targets).",
+               [({"quantile": "0.5"}, st["p50_s"]),
+                ({"quantile": "0.95"}, st["p95_s"]),
+                ({"quantile": "0.99"}, st["p99_s"])])
     w = hub.wire_counters()
     if any(w.values()):
         metric("garfield_wire_bytes_total", "counter",

@@ -202,6 +202,13 @@ class StepTimer:
             jax.block_until_ready(block_on)
         self.times.append(time.perf_counter() - t0)
 
+    def record_chunk(self, total_s, k):
+        """Fold one k-step chunked dispatch (``--chunk_steps``): the steps
+        shared one dispatch + one sync, so the only honest per-step number
+        is the mean ``total_s / k`` — recorded k times to keep ``last()``,
+        ``summary()`` and the percentiles per-STEP shaped."""
+        self.times.extend([total_s / k] * k)
+
     def last(self):
         return self.times[-1] if self.times else float("nan")
 
@@ -215,6 +222,13 @@ class StepTimer:
             "min_s": float(a.min()),
             "max_s": float(a.max()),
             "total_s": float(a.sum()),
+            # Tail percentiles: the mean hides the dispatch-tail spread
+            # chunking exists to kill (the 130/s best-window vs 108/s
+            # typical gap, PERF.md r8) — p50/p95/p99 make the fewer-fatter-
+            # dispatches win visible in committed artifacts.
+            "p50_s": float(np.percentile(a, 50)),
+            "p95_s": float(np.percentile(a, 95)),
+            "p99_s": float(np.percentile(a, 99)),
         }
 
 

@@ -73,3 +73,33 @@ _RUN_LAST = {"test_apps.py": 1}
 
 def pytest_collection_modifyitems(config, items):
     items.sort(key=lambda it: _RUN_LAST.get(it.fspath.basename, 0))
+    # Tier-1 budget discipline: any TIER-1 test (not slow-marked) that
+    # drives a full CLI training run (the app_*.main pattern) must live
+    # in a file REGISTERED in _RUN_LAST, so a wall-clock budget hit
+    # starves the slowest, most redundant end-to-end coverage — never
+    # the unit matrix collected behind it. A new e2e-style test added
+    # outside the registered files fails here at collection instead of
+    # silently eating the tier-1 budget first. (Slow-marked app runs are
+    # exempt: they never enter the tier-1 shard.)
+    import inspect
+    import re
+
+    pattern = re.compile(r"\bapp_\w+\.main\(")
+    src_cache = {}
+    for it in items:
+        fn = getattr(it, "function", None)
+        if fn is None or it.get_closest_marker("slow") is not None:
+            continue
+        if it.fspath.basename in _RUN_LAST:
+            continue
+        if fn not in src_cache:
+            try:
+                src_cache[fn] = bool(pattern.search(inspect.getsource(fn)))
+            except (OSError, TypeError):
+                src_cache[fn] = False
+        assert not src_cache[fn], (
+            f"{it.nodeid} drives a full app CLI run (app_*.main) from a "
+            "tier-1 test outside conftest._RUN_LAST — move it to a "
+            "registered end-to-end file (or slow-mark it) so the unit "
+            "matrix keeps collection priority (tier-1 budget discipline)"
+        )

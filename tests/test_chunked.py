@@ -1,0 +1,288 @@
+"""On-device step chunking (parallel/core.make_chunked_step, DESIGN.md §12).
+
+The acceptance bar is TRAJECTORY EQUALITY, not just speed: a K-step chunk
+(one jitted lax.scan dispatch) must be bitwise equal to K per-step
+dispatches — state carry (params, optimizer, stateful GAR centers, worker
+momentum), per-step RNG derivation (fold_in(rng, step) advancing in the
+scan carry), on-device batch indexing (b = (i0 + k) % num_batches), and
+the stacked telemetry TapBundles all included. The fast tests below run
+the richest path per topology on the 8-device CPU mesh and are tier-1;
+the full topology x rule x attack x taps matrix is slow-marked (same
+tiering as the trainer files; see the 1-core contention note in
+tests/test_apps.py).
+
+Boundary clipping (apps/common.chunk_length) gets one unit test per
+boundary kind the loop special-cases: eval points, checkpoint saves,
+crash-schedule re-jits, the profiled step, and end of run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from garfield_tpu import models
+from garfield_tpu.apps.common import chunk_length
+from garfield_tpu.parallel import aggregathor, byzsgd, core, learn, make_mesh
+from garfield_tpu.utils import selectors
+
+NUM_BATCHES = 3
+STEPS = 6
+
+
+def _setup():
+    module = models.select_model("pimanet", "pima")
+    loss = selectors.select_loss("bce")
+    opt = selectors.select_optimizer("sgd", lr=0.05, momentum=0.9)
+    return module, loss, opt
+
+
+def _batch_stack(seed=0, bsz=16):
+    """(slots=8, num_batches, bsz, 8) stacks of the learnable pima task."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(8, NUM_BATCHES, bsz, 8)).astype(np.float32)
+    y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _run_per_step(step_fn, state, xs, ys, steps=STEPS):
+    """The app loop's per-step dispatch: one call per step, host-side
+    batch indexing."""
+    metrics = []
+    for i in range(steps):
+        b = i % NUM_BATCHES
+        state, m = step_fn(state, xs[:, b], ys[:, b])
+        metrics.append(jax.device_get(m))
+    stacked = jax.tree.map(lambda *ls: np.stack(ls), *metrics)
+    return state, stacked
+
+
+def _run_chunked(step_fn, state, xs, ys, K, steps=STEPS):
+    """Greedy chunks of size K (clipped at the end), one compiled program
+    per distinct length — the app loop's chunked dispatch."""
+    fns, metrics, i = {}, [], 0
+    while i < steps:
+        k = min(K, steps - i)
+        fn = fns.setdefault(k, core.make_chunked_step(step_fn, k, NUM_BATCHES))
+        state, m = fn(state, xs, ys, np.int32(i))
+        metrics.append(jax.device_get(m))
+        i += k
+    stacked = jax.tree.map(lambda *ls: np.concatenate(ls), *metrics)
+    return state, stacked
+
+
+def _assert_bitwise_equal(ref, got):
+    """Every leaf of (state, metrics) pairs identical to the bit."""
+    ra, ga = jax.tree.leaves(jax.device_get(ref)), jax.tree.leaves(
+        jax.device_get(got)
+    )
+    assert len(ra) == len(ga)
+    for a, b in zip(ra, ga):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _compare(init_fn, step_fn, ks=(1, 4, 8)):
+    xs, ys = _batch_stack()
+    state0 = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+    ref_state, ref_metrics = _run_per_step(step_fn, state0, xs, ys)
+    for K in ks:
+        got_state, got_metrics = _run_chunked(step_fn, state0, xs, ys, K)
+        _assert_bitwise_equal(ref_state, got_state)
+        _assert_bitwise_equal(ref_metrics, got_metrics)
+
+
+# --- tier-1 fast path per topology ------------------------------------------
+
+
+def test_aggregathor_chunked_bitwise_equal():
+    """Richest SSMW path: krum + lie + taps + subset quorums, K in
+    {1, 4, 8} with a clipped tail chunk (6 steps)."""
+    module, loss, opt = _setup()
+    init_fn, step_fn, _ = aggregathor.make_trainer(
+        module, loss, opt, "krum", num_workers=8, f=2, attack="lie",
+        telemetry=True,
+    )
+    _compare(init_fn, step_fn)
+
+
+def test_learn_stateful_center_chunked_bitwise_equal():
+    """LEARN + cclip: the carried per-node center (TrainState.gar_state)
+    and the step-0 median-init lax.cond must carry across scan iterations
+    exactly as across dispatches. Per-node wait-n-f subsets exercise the
+    per-step key splits."""
+    module, loss, opt = _setup()
+    init_fn, step_fn, _ = learn.make_trainer(
+        module, loss, opt, "cclip", num_nodes=8, f=2, attack="lie",
+        subset=6,
+    )
+    _compare(init_fn, step_fn, ks=(1, 4))
+
+
+def test_byzsgd_chunked_bitwise_equal():
+    """MSMW on the 2-D (ps=2, workers=4) mesh: per-PS gradient quorums +
+    the model gather plane + observer-mean taps, chunked."""
+    module, loss, opt = _setup()
+    mesh = make_mesh({"ps": 2, "workers": 4})
+    init_fn, step_fn, _ = byzsgd.make_trainer(
+        module, loss, opt, "median", num_workers=8, num_ps=2, fw=1,
+        attack="lie", mesh=mesh, telemetry=True,
+    )
+    _compare(init_fn, step_fn, ks=(4,))
+
+
+def test_worker_momentum_chunk_carry():
+    """The per-worker momentum stack (TrainState.worker_mom) is part of
+    the scan carry — EMA state after a chunk must equal the per-step
+    run's."""
+    module, loss, opt = _setup()
+    opt_plain = selectors.select_optimizer("sgd", lr=0.2)
+    init_fn, step_fn, _ = aggregathor.make_trainer(
+        module, loss, opt_plain, "cclip", num_workers=8, f=2, attack="lie",
+        worker_momentum=0.9,
+    )
+    _compare(init_fn, step_fn, ks=(4,))
+
+
+def test_rolled_scan_flavor_bitwise_equal():
+    """Both scan flavors must be trajectory-exact: the CPU default is the
+    fully-unrolled body (rolled while loops pin conv layouts on XLA:CPU,
+    PERF.md r9), device backends keep the rolled loop — pin the ROLLED
+    flavor against per-step here so the non-default path stays covered."""
+    module, loss, opt = _setup()
+    init_fn, step_fn, _ = aggregathor.make_trainer(
+        module, loss, opt, "krum", num_workers=8, f=2, attack="lie",
+    )
+    xs, ys = _batch_stack()
+    state0 = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+    ref_state, ref_metrics = _run_per_step(step_fn, state0, xs, ys)
+    rolled = core.make_chunked_step(step_fn, 3, NUM_BATCHES, unroll=1)
+    state, metrics = state0, []
+    for i in range(0, STEPS, 3):
+        state, m = rolled(state, xs, ys, np.int32(i))
+        metrics.append(jax.device_get(m))
+    _assert_bitwise_equal(ref_state, state)
+    _assert_bitwise_equal(
+        ref_metrics, jax.tree.map(lambda *ls: np.concatenate(ls), *metrics)
+    )
+
+
+def test_make_chunked_step_validates():
+    module, loss, opt = _setup()
+    init_fn, step_fn, _ = aggregathor.make_trainer(
+        module, loss, opt, "average", num_workers=8
+    )
+    with pytest.raises(ValueError):
+        core.make_chunked_step(step_fn, 0, NUM_BATCHES)
+    with pytest.raises(ValueError):
+        core.make_chunked_step(step_fn, 4, 0)
+
+
+# --- slow full acceptance matrix --------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("topology", ["aggregathor", "byzsgd", "learn"])
+@pytest.mark.parametrize("gar", ["krum", "median", "cclip"])
+@pytest.mark.parametrize("attack", ["lie", None])
+@pytest.mark.parametrize("telemetry", [True, False])
+def test_chunked_matrix(topology, gar, attack, telemetry):
+    """The full acceptance grid: every topology x {krum, median, cclip} x
+    {lie, none}, taps on and off, K in {1, 4, 8} — all bitwise equal to
+    per-step on the 8-device CPU mesh. The DECLARED tolerance stays f=2
+    in the fault-free cells (krum's contract needs f >= 1; tolerating
+    Byzantine workers that never show up is the normal deployment)."""
+    module, loss, opt = _setup()
+    f = 2
+    if topology == "aggregathor":
+        init_fn, step_fn, _ = aggregathor.make_trainer(
+            module, loss, opt, gar, num_workers=8, f=f, attack=attack,
+            telemetry=telemetry,
+        )
+    elif topology == "byzsgd":
+        # Model plane on median: krum cannot validate a 2-row model
+        # gather (needs n >= 2f+3); the grid varies the GRADIENT rule.
+        mesh = make_mesh({"ps": 2, "workers": 4})
+        init_fn, step_fn, _ = byzsgd.make_trainer(
+            module, loss, opt, gar, num_workers=8, num_ps=2, fw=f,
+            model_gar="median", attack=attack, mesh=mesh,
+            telemetry=telemetry,
+        )
+    else:
+        # LEARN exports phase-2 taps only when asked via telemetry=True
+        # like the others; cclip additionally carries per-node centers.
+        init_fn, step_fn, _ = learn.make_trainer(
+            module, loss, opt, gar, num_nodes=8, f=f, attack=attack,
+            telemetry=telemetry,
+        )
+    _compare(init_fn, step_fn)
+
+
+@pytest.mark.slow
+def test_learn_non_iid_agreement_rounds_chunked():
+    """ceil(log2 t) agreement rounds are data-dependent on the step
+    counter — inside a chunk the counter advances in the carry, so round
+    counts per scan iteration must match the per-step run's."""
+    module, loss, opt = _setup()
+    init_fn, step_fn, _ = learn.make_trainer(
+        module, loss, opt, "median", num_nodes=8, f=1, attack="lie",
+        non_iid=True,
+    )
+    _compare(init_fn, step_fn, ks=(4, 8))
+
+
+# --- boundary clipping: one test per boundary kind --------------------------
+
+
+class TestChunkLength:
+    def test_eval_boundary(self):
+        # Eval after step j (j % acc_freq == 0): the chunk may include j
+        # but must end at j + 1.
+        assert chunk_length(1, chunk=8, num_iter=100, acc_freq=6) == 6
+        # i itself an eval point: single-step chunk, then eval.
+        assert chunk_length(0, chunk=8, num_iter=100, acc_freq=6) == 1
+        assert chunk_length(6, chunk=8, num_iter=100, acc_freq=6) == 1
+        # far from the next eval point: full chunk.
+        assert chunk_length(7, chunk=4, num_iter=100, acc_freq=100) == 4
+
+    def test_checkpoint_boundary(self):
+        # Save fires after step j with (j + 1) % freq == 0: the chunk ends
+        # on the next multiple of the cadence.
+        assert chunk_length(0, chunk=8, num_iter=100, checkpoint_freq=6) == 6
+        assert chunk_length(4, chunk=4, num_iter=100, checkpoint_freq=6) == 2
+        assert chunk_length(6, chunk=4, num_iter=100, checkpoint_freq=6) == 4
+
+    def test_crash_boundary(self):
+        # A crash event at step s re-jits the step program: no chunk may
+        # span s; the chunk STARTING at s runs under the new program.
+        assert chunk_length(0, chunk=8, num_iter=100, crash_steps=[5]) == 5
+        assert chunk_length(5, chunk=8, num_iter=100, crash_steps=[5]) == 8
+        assert chunk_length(3, chunk=8, num_iter=100,
+                            crash_steps=[5, 7]) == 2
+
+    def test_profile_boundary(self):
+        # The profiled step runs as its own single-step dispatch.
+        assert chunk_length(2, chunk=8, num_iter=100, profile_step=5) == 3
+        assert chunk_length(5, chunk=8, num_iter=100, profile_step=5) == 1
+        assert chunk_length(6, chunk=8, num_iter=100, profile_step=5) == 8
+
+    def test_end_of_run_boundary(self):
+        assert chunk_length(7, chunk=8, num_iter=10) == 3
+        assert chunk_length(9, chunk=8, num_iter=10) == 1
+
+    def test_boundaries_compose(self):
+        # All clips apply at once; the tightest wins, and the result is
+        # never below 1 (a boundary AT i still advances the loop).
+        assert chunk_length(
+            1, chunk=8, num_iter=6, acc_freq=4, checkpoint_freq=3,
+            crash_steps=[2], profile_step=5,
+        ) == 1  # crash at 2 is the tightest
+        assert chunk_length(
+            2, chunk=8, num_iter=6, acc_freq=4, checkpoint_freq=3,
+            crash_steps=[2], profile_step=5,
+        ) == 1  # checkpoint at end 3
+
+    def test_chunk_one_is_per_step(self):
+        for i in range(10):
+            assert chunk_length(
+                i, chunk=1, num_iter=10, acc_freq=3, checkpoint_freq=4
+            ) == 1

@@ -262,10 +262,32 @@ class TestExporters:
         {"schema": "garfield-telemetry", "v": 1, "kind": "bench"},
         {"schema": "garfield-telemetry", "v": 1, "kind": "gar_bench",
          "gar": "krum", "n": "8", "f": 2, "d": 10},
+        # schema v2: bench chunk-attribution and step-time percentiles
+        # must be well-typed when present.
+        {"schema": "garfield-telemetry", "v": 2, "kind": "bench",
+         "metric": "m", "value": 1.0, "chunk_steps": 0},
+        {"schema": "garfield-telemetry", "v": 2, "kind": "bench",
+         "metric": "m", "value": 1.0, "chunk_steps": "4"},
+        {"schema": "garfield-telemetry", "v": 2, "kind": "summary",
+         "steps": 1, "events": 0, "step_time": [0.1]},
+        {"schema": "garfield-telemetry", "v": 2, "kind": "summary",
+         "steps": 1, "events": 0,
+         "step_time": {"mean_s": 0.1, "p95_s": "fast"}},
     ])
     def test_validate_rejects_malformed(self, bad):
         with pytest.raises(ValueError, match="schema violation"):
             validate_record(bad)
+
+    def test_v2_step_time_percentiles_and_chunk_steps_validate(self):
+        validate_record(make_record(
+            "summary", steps=3, events=0,
+            step_time={"count": 3, "mean_s": 0.1, "p50_s": 0.09,
+                       "p95_s": 0.2, "p99_s": 0.3},
+        ))
+        validate_record(make_record(
+            "bench", metric="m", value=1.0, unit="steps/s/chip",
+            chunk_steps=8,
+        ))
 
     def test_malformed_jsonl_fails_loudly(self, tmp_path):
         path = tmp_path / "bad.jsonl"
