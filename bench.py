@@ -167,6 +167,7 @@ def _emit_jsonl(fields):
                     mfu=fields.get("mfu"),
                     chunk_steps=fields.get("chunk_steps"),
                     error=fields.get("error"),
+                    backend_outage=fields.get("backend_outage"),
                     t=time.time(),
                 ),
             )
@@ -185,6 +186,20 @@ def main():
         _main_impl()
     except Exception as e:  # noqa: BLE001 — the JSON contract is total
         err = {"error": f"{type(e).__name__}: {e}"}
+        # Machine-readable outage stamp: BENCH_r05/MULTICHIP_r05 died to a
+        # TPU-tunnel outage and the ratchet tooling had to be TOLD by a
+        # human that those lines were environment, not regression. A
+        # transient backend/tunnel failure now marks itself so future
+        # ratchets filter outage captures mechanically (BASELINE.md).
+        try:
+            from garfield_tpu.utils import profiling as _prof
+
+            err["backend_outage"] = bool(
+                _prof.is_transient_backend_error(e)
+                or "backend" in str(e).lower()
+            )
+        except Exception:  # noqa: BLE001 — stamping must not mask the error
+            pass
         print(json.dumps(err))
         _emit_jsonl(err)
         sys.exit(0)

@@ -17,6 +17,7 @@ committed value is the min over ``--trials`` independent measurements
 
 import argparse
 import json
+import resource
 import sys
 import time
 
@@ -25,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ... import aggregators
+from ...aggregators import hierarchy
 from ...utils import profiling
 
 # Practical bound for brute's exhaustive enumeration, like the reference's
@@ -36,7 +38,15 @@ INCOMPATIBLE = object()
 
 
 def max_f(rule, n):
-    """Largest f each rule's contract admits (aggregators/*.check)."""
+    """Largest f each rule's contract admits (aggregators/*.check; the
+    hier-* rules report their composed capacity, aggregators/hierarchy)."""
+    if rule.startswith("hier"):
+        try:
+            bucket_gar, top_gar = hierarchy.parse_hier_name(rule)
+        except ValueError:
+            bucket_gar, top_gar = "krum", None  # the env-configured alias
+        cap = hierarchy.max_tolerated_f(n, bucket_gar, top_gar)
+        return max(cap or 0, 0)
     bounds = {
         "krum": (n - 3) // 2,
         "bulyan": (n - 3) // 4,
@@ -50,6 +60,13 @@ def max_f(rule, n):
     }
     base = rule.split("native-")[-1]
     return max(bounds.get(base, 0), 0)
+
+
+def peak_rss_bytes():
+    """Process high-water RSS in bytes (``getrusage``; monotone — sweep
+    rows are recorded in ascending-n order so O(buckets)-memory claims are
+    visible as a flat profile, not laundered by earlier peaks)."""
+    return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
 
 
 def bench_one(gar, n, f, d, reps, key, trials=1):
@@ -119,13 +136,63 @@ def bench_one(gar, n, f, d, reps, key, trials=1):
     return min(vals) if vals else None
 
 
+def hier_bench_one(name, n, f, d, *, bucket_size, wave, trials, seed=0):
+    """Time one hierarchical cell END TO END through the streaming reducer:
+    full wave-based ingest of n clients plus the cascaded folds plus
+    ``finalize`` — the federated arrival pattern, not an (n, d)-resident
+    microkernel. Memory stays O(wave · bucket_size · d): client waves are
+    generated into two fixed pools cycled through ``push_many`` (generation
+    stays OUTSIDE the timed region), so the (n, d) stack never exists —
+    at n = 2^17, d = 1e5 that stack alone would be 52 GB.
+
+    DCE guard: finalize()'s host readback is a hard sync, and the returned
+    aggregate is still consumed through the softsign map (the r5
+    microbench-trap rule) so no consumer-side rewrite can shed it. The
+    committed value is the min over ``trials`` full runs (VERDICT r4 #3).
+    """
+    bucket_gar, top_gar = hierarchy.parse_hier_name(name)
+    rng = np.random.default_rng(seed)
+    wave_rows = wave * bucket_size
+    pools = [rng.normal(size=(wave_rows, d)).astype(np.float32)
+             for _ in range(2)]
+
+    def run_once():
+        red = hierarchy.StreamingAggregator(
+            n, f, bucket_gar=bucket_gar, top_gar=top_gar,
+            bucket_size=bucket_size, wave_buckets=wave,
+        )
+        i = 0
+        while i < n:
+            pool = pools[(i // wave_rows) % 2]
+            take = min(wave_rows, n - i)
+            red.push_many(pool[:take])
+            i += take
+        out = red.finalize()
+        guarded = float(np.sum(out * (1.0 / np.sqrt(1.0 + out * out))))
+        return guarded, red.plan
+
+    _, plan = run_once()  # compile + warm
+    vals = []
+    for _ in range(max(1, trials)):
+        t0 = time.perf_counter()
+        run_once()
+        vals.append(time.perf_counter() - t0)
+    total = min(vals)
+    return {
+        "latency_s": total,
+        "per_client_s": total / n,
+        "bucket_size": bucket_size,
+        "wave_buckets": wave,
+        "levels": plan.num_levels,
+        "num_buckets": plan.num_buckets,
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="GAR latency microbenchmark")
-    p.add_argument("--gars", nargs="*", default=sorted(aggregators.gars))
-    p.add_argument("--ns", nargs="*", type=int,
-                   default=[2 ** k for k in range(2, 8)])
-    p.add_argument("--ds", nargs="*", type=int,
-                   default=[10 ** k for k in range(1, 5)])
+    p.add_argument("--gars", nargs="*", default=None)
+    p.add_argument("--ns", nargs="*", type=int, default=None)
+    p.add_argument("--ds", nargs="*", type=int, default=None)
     p.add_argument("--reps", type=int, default=10)
     p.add_argument("--trials", type=int, default=3,
                    help="Independent min-of-pairs timing trials per cell; "
@@ -133,52 +200,128 @@ def main(argv=None):
                         "#3 min-over-k — co-tenant noise only adds time).")
     p.add_argument("--f_mode", choices=["max", "one"], default="max",
                    help="f per (rule, n): contract maximum or fixed 1.")
+    p.add_argument("--hier", action="store_true",
+                   help="Hierarchical federated-scale grid: streaming-"
+                        "ingest hier-* rules at n in 2^10..2^17 (defaults; "
+                        "override with --gars/--ns/--ds), peak-RSS per "
+                        "row, 'hier_bench' JSONL records — HIERBENCH_r*'s "
+                        "capture mode.")
+    p.add_argument("--hier_bucket", type=int, default=None,
+                   help="Hierarchy bucket size (default MAX_SORT_N=32, "
+                        "the Pallas sorting-network sweet spot).")
+    p.add_argument("--hier_wave", type=int, default=8,
+                   help="Streaming wave width: buckets folded per vmapped "
+                        "dispatch.")
+    p.add_argument("--flat_baseline", nargs="*", type=int, default=None,
+                   metavar="N",
+                   help="With --hier: also time the flat krum/median cells "
+                        "at these n (same container, same methodology) so "
+                        "the artifact carries its own apples-to-apples "
+                        "baseline — GARBENCH_r3's flat numbers are a CHIP "
+                        "capture (BASELINE.md).")
     p.add_argument("--json", type=str, default=None,
                    help="Also dump results to this JSON file (plus the "
                         "schema-versioned telemetry JSONL twin at the same "
-                        "path with a .jsonl suffix — one 'gar_bench' record "
-                        "per cell, validated by the tier-1 schema check).")
+                        "path with a .jsonl suffix — one 'gar_bench'/"
+                        "'hier_bench' record per cell, validated by the "
+                        "tier-1 schema check).")
     args = p.parse_args(argv)
+
+    if args.hier:
+        names = args.gars or ["hier-krum", "hier-median"]
+        ns = args.ns or [2 ** k for k in range(10, 18)]
+        ds = args.ds or [10 ** 5]
+    else:
+        names = args.gars or sorted(
+            g for g in aggregators.gars if not g.startswith("hier"))
+        ns = args.ns or [2 ** k for k in range(2, 8)]
+        ds = args.ds or [10 ** k for k in range(1, 5)]
 
     key = jax.random.PRNGKey(0)
     results = []
-    for name in args.gars:
+
+    def flat_cell(name, n, d, trials):
         gar = aggregators.gars[name]
-        for n in args.ns:
-            if name.endswith("brute") and n > BRUTE_MAX_N:
-                continue
-            f = max_f(name, n) if args.f_mode == "max" else min(1, max_f(name, n))
-            for d in args.ds:
-                key, sub = jax.random.split(key)
-                try:
-                    latency = bench_one(
-                        gar, n, f, d, args.reps, sub, trials=args.trials
-                    )
-                except Exception as exc:
-                    print(f"{name} n={n} f={f} d={d}: SKIP ({exc})",
-                          file=sys.stderr)
+        f = max_f(name, n) if args.f_mode == "max" else min(1, max_f(name, n))
+        nonlocal key
+        key, sub = jax.random.split(key)
+        try:
+            latency = bench_one(gar, n, f, d, args.reps, sub, trials=trials)
+        except Exception as exc:
+            print(f"{name} n={n} f={f} d={d}: SKIP ({exc})", file=sys.stderr)
+            return None
+        if latency is INCOMPATIBLE:
+            return None
+        row = {"gar": name, "n": n, "f": f, "d": d,
+               "latency_s": latency,
+               # provenance: future GARBENCH_r* readers can tell
+               # guarded min-over-k sweeps from the r3/r4 format
+               "trials": trials, "dce_guard": "softsign",
+               "peak_rss_bytes": peak_rss_bytes()}
+        results.append(row)
+        if latency is None:  # below noise floor (paired_reps)
+            row["below_noise_floor"] = True
+            print(f"{name:>16} n={n:<4} f={f:<3} d={d:<7} "
+                  f"below noise floor", flush=True)
+        else:
+            print(f"{name:>16} n={n:<4} f={f:<3} d={d:<7} "
+                  f"{latency * 1e3:8.3f} ms", flush=True)
+        return row
+
+    for name in names:
+        if name.startswith("hier"):
+            bucket = args.hier_bucket or hierarchy.DEFAULT_BUCKET_SIZE
+            # Ascending n: ru_maxrss is a high-water mark, so this order
+            # makes the O(buckets) memory profile readable row-to-row.
+            for n in sorted(ns):
+                f = (max_f(name, n) if args.f_mode == "max"
+                     else min(1, max_f(name, n)))
+                for d in ds:
+                    try:
+                        cell = hier_bench_one(
+                            name, n, f, d, bucket_size=bucket,
+                            wave=args.hier_wave, trials=args.trials,
+                        )
+                    except Exception as exc:
+                        print(f"{name} n={n} f={f} d={d}: SKIP ({exc})",
+                              file=sys.stderr)
+                        continue
+                    row = {"gar": name, "n": n, "f": f, "d": d,
+                           "grid": "hier", "trials": args.trials,
+                           "dce_guard": "softsign",
+                           "peak_rss_bytes": peak_rss_bytes(), **cell}
+                    results.append(row)
+                    print(f"{name:>16} n={n:<7} f={f:<6} d={d:<7} "
+                          f"{cell['latency_s']:8.3f} s total  "
+                          f"{cell['per_client_s'] * 1e6:9.1f} us/client  "
+                          f"rss {row['peak_rss_bytes'] / 2**20:7.0f} MiB",
+                          flush=True)
+        else:
+            for n in sorted(ns):
+                if name.endswith("brute") and n > BRUTE_MAX_N:
                     continue
-                if latency is INCOMPATIBLE:
-                    continue
-                row = {"gar": name, "n": n, "f": f, "d": d,
-                       "latency_s": latency,
-                       # provenance: future GARBENCH_r* readers can tell
-                       # guarded min-over-k sweeps from the r3/r4 format
-                       "trials": args.trials, "dce_guard": "softsign"}
-                results.append(row)
-                if latency is None:  # below noise floor (paired_reps)
-                    row["below_noise_floor"] = True
-                    print(f"{name:>16} n={n:<4} f={f:<3} d={d:<7} "
-                          f"below noise floor", flush=True)
-                else:
-                    print(f"{name:>16} n={n:<4} f={f:<3} d={d:<7} "
-                          f"{latency * 1e3:8.3f} ms", flush=True)
+                for d in ds:
+                    flat_cell(name, n, d, args.trials)
+
+    # Same-container flat anchor cells for the hier artifact (reps=1:
+    # a flat median at n=512, d=1e5 runs ~7 s PER CALL on this class of
+    # host — the paired-reps chain at default reps would take hours).
+    if args.hier and args.flat_baseline:
+        saved_reps, args.reps = args.reps, 1
+        for n in args.flat_baseline:
+            for base in ("krum", "median"):
+                for d in ds:
+                    row = flat_cell(base, n, d, 1)
+                    if row is not None:
+                        row["grid"] = "flat_baseline"
+        args.reps = saved_reps
+
     if args.json:
         with open(args.json, "w") as fp:
             json.dump(results, fp, indent=1)
         # Schema-versioned JSONL twin (telemetry/exporters.py): the format
-        # future GARBENCH_r* artifacts adopt — the tier-1 schema check
-        # validates it, so a malformed sweep fails loudly.
+        # GARBENCH_r*/HIERBENCH_r* artifacts adopt — the tier-1 schema
+        # check validates it, so a malformed sweep fails loudly.
         import os
 
         from ...telemetry import exporters
@@ -186,13 +329,29 @@ def main(argv=None):
         jsonl_path = os.path.splitext(args.json)[0] + ".jsonl"
         with exporters.JsonlExporter(jsonl_path) as exp:
             for row in results:
-                exp.write(exporters.make_record(
-                    "gar_bench",
-                    gar=row["gar"], n=row["n"], f=row["f"], d=row["d"],
-                    latency_s=row["latency_s"],
-                    below_noise_floor=row.get("below_noise_floor", False),
-                    trials=row["trials"], dce_guard=row["dce_guard"],
-                ))
+                if row.get("grid") == "hier":
+                    exp.write(exporters.make_record(
+                        "hier_bench",
+                        gar=row["gar"], n=row["n"], f=row["f"], d=row["d"],
+                        bucket_size=row["bucket_size"],
+                        levels=row["levels"],
+                        num_buckets=row["num_buckets"],
+                        latency_s=row["latency_s"],
+                        per_client_s=row["per_client_s"],
+                        peak_rss_bytes=row["peak_rss_bytes"],
+                        wave_buckets=row["wave_buckets"],
+                        trials=row["trials"], dce_guard=row["dce_guard"],
+                    ))
+                else:
+                    exp.write(exporters.make_record(
+                        "gar_bench",
+                        gar=row["gar"], n=row["n"], f=row["f"], d=row["d"],
+                        latency_s=row["latency_s"],
+                        below_noise_floor=row.get(
+                            "below_noise_floor", False),
+                        trials=row["trials"], dce_guard=row["dce_guard"],
+                        peak_rss_bytes=row["peak_rss_bytes"],
+                    ))
     return results
 
 

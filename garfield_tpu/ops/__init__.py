@@ -19,6 +19,8 @@ from .coordinate import (
     MAX_SORT_N,
     averaged_median_mean,
     coordinate_median,
+    sortnet_median,
+    sortnet_trimmed_mean,
     trimmed_mean,
     use_pallas,
 )
@@ -27,6 +29,8 @@ __all__ = [
     "MAX_SORT_N",
     "averaged_median_mean",
     "coordinate_median",
+    "sortnet_median",
+    "sortnet_trimmed_mean",
     "trimmed_mean",
     "use_pallas",
 ]

@@ -34,12 +34,16 @@ __all__ = [
 SCHEMA = "garfield-telemetry"
 # v2 (round 9): summary.step_time gained p50_s/p95_s/p99_s tail
 # percentiles (the chunked-dispatch win lives in the tail, not the mean)
-# and bench records gained the chunk_steps attribution field. v1 records
+# and bench records gained the chunk_steps attribution field. v3 (round
+# 10): the ``hier_bench`` kind (hierarchical bucketed-GAR sweep cells —
+# HIERBENCH_r*'s format, with peak-RSS accounting), ``gar_bench`` rows may
+# carry ``peak_rss_bytes``, and bench error records may carry
+# ``backend_outage`` (the BENCH_r05/MULTICHIP_r05 filter). v1/v2 records
 # still validate — consumers key on field presence, not version.
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
-         "transfer_bench", "exchange_bench")
+         "transfer_bench", "exchange_bench", "hier_bench")
 
 
 def make_record(kind, **fields):
@@ -178,6 +182,27 @@ def validate_record(rec):
         lat = rec.get("latency_s")
         if lat is not None and not _is_num(lat):
             _fail(f"gar_bench.latency_s must be a number or null, got {lat!r}")
+    elif kind == "hier_bench":
+        if not isinstance(rec.get("gar"), str):
+            _fail(f"hier_bench.gar must be a string, got {rec.get('gar')!r}")
+        for key in ("n", "f", "d", "bucket_size", "levels", "num_buckets"):
+            val = rec.get(key)
+            if not isinstance(val, int) or isinstance(val, bool):
+                _fail(f"hier_bench.{key} must be an int, got {val!r}")
+        for key in ("latency_s", "per_client_s"):
+            val = rec.get(key)
+            if val is not None and not _is_num(val):
+                _fail(
+                    f"hier_bench.{key} must be a number or null, got {val!r}"
+                )
+        rss = rec.get("peak_rss_bytes")
+        if rss is not None and (
+            not isinstance(rss, int) or isinstance(rss, bool) or rss < 0
+        ):
+            _fail(
+                f"hier_bench.peak_rss_bytes must be a non-negative int or "
+                f"null, got {rss!r}"
+            )
     elif kind == "transfer_bench":
         for key in ("devices", "d"):
             val = rec.get(key)

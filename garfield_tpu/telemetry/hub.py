@@ -135,6 +135,21 @@ class MetricsHub:
                     self._wire[key] += float(fields.get(key, 0.0) or 0.0)
             elif kind == "send_queue_drop":
                 self._wire["send_queue_drops"] += 1
+            elif kind == "hier_exclusion":
+                # The hierarchical reducer's per-client audit (aggregators/
+                # hierarchy.py): observed/selected weight vectors over the
+                # n CLIENTS, folded into the same exclusion-frequency
+                # suspicion the in-graph taps feed — bucket-level
+                # exclusions (and whole excluded bucket summaries) surface
+                # per client without ground truth.
+                obs = np.asarray(fields.get("observed", ()), np.float64)
+                sel = np.asarray(fields.get("selected", ()), np.float64)
+                if obs.size and sel.size == obs.size:
+                    self._ensure_ranks(obs.size)
+                    if obs.size == self._observed.size:
+                        self._observed += obs
+                        self._excluded += np.maximum(
+                            obs - np.minimum(sel, obs), 0.0)
             self._ring.append(rec)
             self._drain(rec)
             return rec

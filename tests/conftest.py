@@ -68,7 +68,10 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 # unit matrix behind it. Run units first and the end-to-end runs last: a
 # timeout then costs the slowest, most redundant coverage (the app flows
 # are also exercised piecewise by the unit files), not the matrix.
-_RUN_LAST = {"test_apps.py": 1}
+# test_hierarchy_stream.py is end-to-end too (slow-marked multi-wave
+# TCP-exchange ingest into the hierarchical reducer) and collects before
+# the app runs when slow tests are enabled.
+_RUN_LAST = {"test_hierarchy_stream.py": 1, "test_apps.py": 2}
 
 
 def pytest_collection_modifyitems(config, items):

@@ -68,3 +68,21 @@ class TestBenchErrorContract:
         assert lines, "bench printed nothing to stdout"
         payload = json.loads(lines[-1])
         assert "error" in payload and payload["error"]
+        # Outage stamping (ISSUE 6 satellite): a config error is NOT a
+        # backend outage — the mechanical filter must not flag it.
+        assert payload.get("backend_outage") is False
+
+
+def test_outage_error_is_stamped_transient():
+    """The r5 outage signature ('UNAVAILABLE: TPU backend setup/compile
+    error', BENCH_r05.json) must classify as a transient backend error —
+    the predicate behind bench.py's ``backend_outage: true`` stamp that
+    lets future ratchets filter outage captures mechanically."""
+    exc = RuntimeError(
+        "Unable to initialize backend 'axon': UNAVAILABLE: TPU backend "
+        "setup/compile error (Unavailable)."
+    )
+    assert profiling.is_transient_backend_error(exc)
+    assert not profiling.is_transient_backend_error(
+        ValueError("unknown GAR 'no-such-rule'")
+    )

@@ -1,0 +1,120 @@
+"""End-to-end multi-wave streaming ingest over the host exchange plane.
+
+The federated arrival pattern the hierarchy exists for: W worker peers
+publish typed wire frames over R rounds through ``PeerExchange``, the
+collector's PRE-REGISTERED waiters (``collect_begin``) hand each frame to
+``StreamingAggregator.wire_transform`` in the waiter threads (decode +
+bucket folding overlap the quorum wait), and the finalized aggregate must
+equal the batch hierarchy over the stack in the reducer's actual arrival
+order — bitwise. Slow-marked and registered in conftest._RUN_LAST: it
+spins a real TCP mesh.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+pytest.importorskip("garfield_tpu.native")
+from garfield_tpu import native
+
+if native.load() is None:  # no compiler / native runtime in this env
+    pytest.skip("native runtime unavailable", allow_module_level=True)
+
+from garfield_tpu.aggregators import hierarchy
+from garfield_tpu.utils import wire
+from garfield_tpu.utils.exchange import PeerExchange
+
+
+def _ports(k):
+    socks = [socket.socket() for _ in range(k)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+@pytest.mark.slow
+def test_multi_wave_exchange_ingest_matches_batch():
+    workers, rounds, d, bucket = 4, 16, 256, 8
+    n = workers * rounds  # 64 clients over 16 waves
+    f = 3
+    hosts = [f"127.0.0.1:{p}" for p in _ports(workers + 1)]
+    peers = [PeerExchange(i, hosts) for i in range(workers + 1)]
+    collector, senders = peers[0], peers[1:]
+
+    rng = np.random.default_rng(99)
+    grads = rng.normal(size=(rounds, workers, d)).astype(np.float32)
+
+    red = hierarchy.StreamingAggregator(
+        n, f, bucket_gar="krum", top_gar="median", bucket_size=bucket,
+        wave_buckets=2)
+    arrival = {}
+    arrival_lock = threading.Lock()
+
+    def transform(idx, payload):
+        vec = wire.decode(payload)
+        pos = red.push(vec)
+        with arrival_lock:
+            arrival[pos] = np.asarray(vec, np.float32)
+        return pos
+
+    try:
+        for step in range(rounds):
+            wait = collector.collect_begin(
+                step, q=workers, peers=list(range(1, workers + 1)),
+                timeout_ms=30_000, transform=transform)
+            for w, sender in enumerate(senders):
+                sender.publish(step, wire.encode(grads[step, w]), to=[0])
+            got = wait()
+            assert len(got) == workers
+            assert all(isinstance(v, int) for v in got.values())
+        streamed = red.finalize()
+    finally:
+        for p in peers:
+            p.close()
+
+    assert len(arrival) == n
+    stack = np.stack([arrival[i] for i in range(n)])
+    batch = np.asarray(hierarchy.aggregate(
+        stack, f, bucket_gar="krum", top_gar="median", bucket_size=bucket))
+    assert np.array_equal(streamed, batch)
+
+
+@pytest.mark.slow
+def test_exchange_ingest_attributes_codec_rejects():
+    """A Byzantine sender's corrupted frame must surface as that peer's
+    attributable WireError in the collect result — ban evidence — while
+    the honest frames still fold into the reducer."""
+    workers, d = 3, 64
+    hosts = [f"127.0.0.1:{p}" for p in _ports(workers + 1)]
+    peers = [PeerExchange(i, hosts) for i in range(workers + 1)]
+    collector, senders = peers[0], peers[1:]
+    red = hierarchy.StreamingAggregator(
+        workers - 1, 0, bucket_gar="median", bucket_size=2)
+
+    def transform(idx, payload):
+        return red.push(wire.decode(payload))
+
+    try:
+        wait = collector.collect_begin(
+            0, q=workers, peers=list(range(1, workers + 1)),
+            timeout_ms=30_000, transform=transform)
+        rng = np.random.default_rng(5)
+        senders[0].publish(0, wire.encode(rng.normal(size=d)), to=[0])
+        frame = bytearray(wire.encode(rng.normal(size=d)))
+        frame[-1] ^= 0xFF  # payload flip: CRC must catch it
+        senders[1].publish(0, bytes(frame), to=[0])
+        senders[2].publish(0, wire.encode(rng.normal(size=d)), to=[0])
+        got = wait()
+    finally:
+        for p in peers:
+            p.close()
+
+    assert isinstance(got[2], wire.WireError)
+    assert sorted(v for k, v in got.items() if k != 2) == [0, 1]
+    assert red.finalize().shape == (d,)

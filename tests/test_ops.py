@@ -235,6 +235,71 @@ def test_large_n_fallback_warns_only_on_tpu_backend(monkeypatch):
         )
 
 
+def test_large_n_warning_recommends_hierarchy(monkeypatch):
+    """Satellite pin (ISSUE 6): the n > MAX_SORT_N warning must point the
+    user at the hierarchical bucketed rules (the recommended fix), and the
+    XLA fallback it announces must be GRACEFUL — same result as the jnp
+    reference at a federated-ish n."""
+    monkeypatch.setattr(coordinate.jax, "default_backend", lambda: "tpu")
+    coordinate._warned_large_n.discard("trimmed_mean")
+    with pytest.warns(UserWarning) as rec:
+        assert coordinate.use_pallas(64, op="trimmed_mean") is False
+    text = str(rec[0].message)
+    assert "MAX_SORT_N=32" in text
+    assert "hier-krum" in text and "hierarchy" in text
+    # Graceful XLA-path result at n > MAX_SORT_N (the non-Pallas path is
+    # the spec itself).
+    monkeypatch.setattr(coordinate.jax, "default_backend", lambda: "cpu")
+    x = _rand(64, 200, seed=3)
+    np.testing.assert_array_equal(
+        np.asarray(coordinate.coordinate_median(x)),
+        np.asarray(coordinate.coordinate_median_reference(x)),
+    )
+
+
+class TestSortNet:
+    """The jnp odd-even-network entry points (the hierarchical bucket
+    fold's coordinate fast path): bitwise-equal semantics to the reference
+    sorts, batch axes, NaN resilience, and the MAX_SORT_N bound."""
+
+    def test_median_matches_reference_bitwise(self):
+        x = _rand(17, 300, seed=21)
+        np.testing.assert_array_equal(
+            np.asarray(coordinate.sortnet_median(x, axis=0)),
+            np.asarray(coordinate.coordinate_median_reference(x)),
+        )
+
+    def test_median_batched_matches_per_bucket(self):
+        xb = np.stack([_rand(8, 64, seed=s) for s in range(5)])
+        got = np.asarray(coordinate.sortnet_median(xb, axis=1))
+        want = np.stack([
+            np.asarray(coordinate.coordinate_median_reference(xb[i]))
+            for i in range(5)
+        ])
+        np.testing.assert_array_equal(got, want)
+
+    def test_median_nan_resilient(self):
+        x = _rand(9, 40, seed=22)
+        x[:3, :] = np.nan  # up to ceil(n/2)-1 NaNs sort last
+        np.testing.assert_array_equal(
+            np.asarray(coordinate.sortnet_median(x, axis=0)),
+            np.asarray(coordinate.coordinate_median_reference(x)),
+        )
+
+    def test_tmean_matches_reference(self):
+        x = _rand(16, 128, seed=23)
+        np.testing.assert_allclose(
+            np.asarray(coordinate.sortnet_trimmed_mean(x, 3, axis=0)),
+            np.asarray(coordinate.trimmed_mean_reference(x, 3)),
+            rtol=1e-6, atol=1e-6,
+        )
+
+    def test_bounded_by_max_sort_n(self):
+        with pytest.raises(ValueError, match="MAX_SORT_N"):
+            coordinate.sortnet_median(
+                np.zeros((coordinate.MAX_SORT_N + 1, 4), np.float32), axis=0)
+
+
 @pytest.mark.parametrize("op", ["median", "tmean"])
 def test_remap_kernel_matches_materialized(op):
     """row_map/row_scale (the folded-attack remap, parallel/fold.py) applied
