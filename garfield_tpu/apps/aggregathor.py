@@ -50,17 +50,30 @@ def main(argv=None):
         "the number of Byzantine workers should be less than half the number "
         "of workers"  # Aggregathor/trainer.py:150-152 invariant
     )
+    make_trainer_kwargs = dict(
+        num_workers=args.num_workers,
+        f=args.fw,
+        attack=args.attack,
+        attack_params=args.attack_params,
+        subset=args.subset,
+        granularity=args.granularity,
+    )
+    from ..utils import rounds
+
+    policy = rounds.resolve(args)
+    if policy is not None:
+        # On-mesh --async: the seeded in-graph emulation of the host
+        # plane's bounded-staleness mode (parallel/aggregathor
+        # ``staleness=``; DESIGN.md §14) — same weighting law, same
+        # flags, one policy deployed at either scale.
+        make_trainer_kwargs["staleness"] = {
+            "max_staleness": policy.max_staleness,
+            "decay": policy.decay,
+        }
     return common.train(
         args,
         topology=aggregathor,
-        make_trainer_kwargs=dict(
-            num_workers=args.num_workers,
-            f=args.fw,
-            attack=args.attack,
-            attack_params=args.attack_params,
-            subset=args.subset,
-            granularity=args.granularity,
-        ),
+        make_trainer_kwargs=make_trainer_kwargs,
         num_slots=args.num_workers,
         tag="aggregathor",
     )

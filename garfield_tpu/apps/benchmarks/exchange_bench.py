@@ -23,14 +23,31 @@ steps/s from the PS's per-step ``step_time_s`` records (median over the
 post-warmup steps — the BASELINE.md cluster-mode row) plus wire
 bytes/step from the summary's wire totals.
 
+**--scenario** (round 11, DESIGN.md §14): the async-plane scenario
+harness. ``straggler`` injects a delayed rank (``--straggler_ms``, or
+10x the measured fault-free round when omitted — the EXCHBENCH_r02
+acceptance shape) and measures the SYNC exact-round rate against the
+bounded-staleness rate at matched (n, d): sync waits on the straggler
+every round; async reuses its admissible stale frame
+(``PeerExchange.round_collector``) and paces on the fast ranks, bounded
+by ``--max_staleness``. ``churn`` kills the victim mid-run and relaunches
+it (leave/join: the quorum q = n-2 flows around the gap; the rejoined
+rank's fresh frames re-enter — re-admit is just re-appearing in the
+admissible set). ``partition`` SIGSTOPs the victim for the middle third
+and SIGCONTs it. Every scenario drives a MetricsHub: per-round
+``staleness`` telemetry events fold the discount deficit into per-rank
+SUSPICION, and each row records the victim ranking top. Every row (micro
+cells included) carries ``peak_rss_bytes`` like HIERBENCH.
+
   python -m garfield_tpu.apps.benchmarks.exchange_bench \\
-      --ns 2 4 --ds 1000 100000 1000000 --wire f32 bf16 \\
-      --json EXCHBENCH_r01.json --e2e
+      --ns 4 --ds 100000 --wire f32 \\
+      --scenario straggler churn partition --json EXCHBENCH_r02.json
 """
 
 import argparse
 import json
 import os
+import signal
 import socket
 import statistics
 import subprocess
@@ -39,12 +56,23 @@ import time
 
 import numpy as np
 
-from ...utils import wire
+from ...utils import rounds as rounds_lib, wire
 from ...utils.exchange import PeerExchange
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))
 )))
+
+# Follow-mode stop sentinel: a round tag no real round reaches.
+_STOP_ROUND = 2 ** 40
+
+
+def peak_rss_bytes():
+    """High-water RSS of this process (bytes) — per-row accounting like
+    HIERBENCH (gar_bench.peak_rss_bytes)."""
+    import resource
+
+    return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
 
 
 def _ports(k):
@@ -115,6 +143,8 @@ def _child_main(args):
     rng = np.random.default_rng(1234 + args.child)
     vec = rng.standard_normal(args.d).astype(np.float32)
     try:
+        if args.child_mode == "follow":
+            return _child_follow(ex, args, vec)
         _barrier(ex, n)
         for step in range(1, 1 + args.rounds * max(1, args.trials)):
             got = ex.collect(step, 1, peers=[0], timeout_ms=120_000,
@@ -123,6 +153,29 @@ def _child_main(args):
             ex.publish(step, wire.encode(vec, args.child_wire), to=[0])
     finally:
         ex.close()
+
+
+def _child_follow(ex, args, vec):
+    """Scenario-mode child: respond to rank 0's NEWEST round (read_latest
+    catch-up — a delayed child skips rounds exactly like a real straggling
+    worker) with an optional injected delay before each publish. The
+    rendezvous is with rank 0 only (not all-to-all): churn relaunches a
+    child mid-run, and a full barrier would hang it on hellos the other
+    children published before it existed."""
+    ex.publish(0, b"up", to=[0])
+    delay_s = max(0, args.child_delay_ms or 0) / 1e3
+    last = 0
+    while True:
+        try:
+            step, _ = ex.read_latest(0, last + 1, timeout_ms=180_000)
+        except TimeoutError:
+            return  # pacer gone (scenario harness was killed)
+        if step >= _STOP_ROUND:
+            return
+        if delay_s:
+            time.sleep(delay_s)  # the injected straggler
+        ex.publish(step, wire.encode(vec, args.child_wire), to=[0])
+        last = step
 
 
 def _spawn_env():
@@ -166,6 +219,7 @@ def bench_cell(n, d, wire_dtype, rounds, trials):
         "round_s": round_s,
         "wire_bytes_per_step": (n - 1) * wire.frame_nbytes(d, wire_dtype),
         "rounds": rounds, "trials": trials,
+        "peak_rss_bytes": peak_rss_bytes(),
     }
 
 
@@ -245,6 +299,275 @@ def bench_e2e(wire_dtype, n_w, iters, tmpdir):
     }
 
 
+def _spawn_follow(k, hosts, d, wire_dtype, delay_ms=0):
+    return subprocess.Popen(
+        [sys.executable, "-m",
+         "garfield_tpu.apps.benchmarks.exchange_bench",
+         "--child", str(k), "--hosts", ",".join(hosts),
+         "--d", str(d), "--child_wire", wire_dtype,
+         "--child_mode", "follow", "--child_delay_ms", str(delay_ms)],
+        env=_spawn_env(),
+    )
+
+
+def _sync_follow_rounds(ex, peers, frame, n_rounds, step):
+    """Exact-round pacing over follow children: publish round ``step``,
+    wait for EVERY peer's response to that exact round — the synchronous
+    wait-everyone contract whose pace a single straggler sets. Returns
+    (median round_s, next step)."""
+    lats = []
+    for _ in range(n_rounds):
+        wait = ex.collect_begin(
+            step, len(peers), peers=peers, timeout_ms=180_000,
+            transform=_decode_tf,
+        )
+        t0 = time.perf_counter()
+        ex.publish(step, frame)
+        got = wait()
+        lats.append(time.perf_counter() - t0)
+        assert not any(isinstance(v, Exception) for v in got.values())
+        step += 1
+    return statistics.median(lats), step
+
+
+def _async_follow_rounds(ex, collector, q, frame, n_rounds, step, policy,
+                         on_round=None, q_min=None, soft_timeout_ms=None):
+    """Bounded-staleness pacing: publish, gather the admissible set
+    (stale reuse + freshness floor — PeerExchange.round_collector), emit
+    the per-round ``staleness`` telemetry event exactly like the cluster
+    PS, so the scenario's MetricsHub derives suspicion from the discount
+    deficits. ``q_min`` < ``q`` enables the liveness degrade the cluster
+    plane applies: a quorum that cannot fill ``q`` inside
+    ``soft_timeout_ms`` (a rank's frames expired past the cutoff — churn
+    leave, partition) retries at ``q_min`` and flows around the outage;
+    the excluded rank re-enters the admissible set the moment it
+    publishes again (re-admission is just reappearance). Returns (median
+    round_s, next step, max staleness seen, per-rank presence counts)."""
+    from ...telemetry import hub as tele_hub_lib
+
+    lats, tau_max = [], 0
+    present = {}
+    degraded = False  # sticky: pay the soft timeout once per outage
+    for r in range(n_rounds):
+        if on_round is not None:
+            on_round(r)
+        t0 = time.perf_counter()
+        ex.publish(step, frame)
+        if degraded:
+            # gather returns ALL admissible frames: the moment the
+            # excluded rank publishes again the count recovers past q
+            # and the full quorum is restored (re-admission).
+            got = collector.gather(
+                step, q_min, max_staleness=policy.max_staleness,
+                timeout_ms=180_000,
+            )
+            if len(got) >= q:
+                degraded = False
+        else:
+            try:
+                got = collector.gather(
+                    step, q, max_staleness=policy.max_staleness,
+                    timeout_ms=(
+                        180_000 if q_min is None else soft_timeout_ms
+                    ),
+                )
+            except TimeoutError:
+                if q_min is None:
+                    raise
+                got = collector.gather(
+                    step, q_min, max_staleness=policy.max_staleness,
+                    timeout_ms=180_000,
+                )
+                degraded = True
+        quorum = sorted(got, key=lambda k: (step - got[k][0], k))[:q]
+        taus = [max(0, step - got[k][0]) for k in quorum]
+        w = policy.weights(np.asarray(taus))
+        lats.append(time.perf_counter() - t0)
+        tau_max = max(tau_max, max(taus))
+        for k in quorum:
+            present[k] = present.get(k, 0) + 1
+        tele_hub_lib.emit_event(
+            "staleness", who="exchange-bench", step=int(step),
+            ranks=[int(k) for k in quorum],
+            staleness=[int(t) for t in taus],
+            weights=[round(float(x), 6) for x in w],
+            reused=int(sum(t > 0 for t in taus)),
+        )
+        step += 1
+    return statistics.median(lats), step, tau_max, present
+
+
+def bench_scenario(scenario, n, d, wire_dtype, rounds, trials,
+                   straggler_ms, max_staleness, decay):
+    """One async-plane scenario cell (docstring up top): returns the
+    committed row. ``straggler`` A/Bs sync vs bounded-staleness round
+    rate under an injected delay (auto: 10x the fault-free round);
+    ``churn`` kills + relaunches the victim; ``partition`` SIGSTOPs it
+    for the middle third. All drive suspicion through real telemetry."""
+    from ...telemetry import hub as tele_hub_lib
+
+    policy = rounds_lib.StalenessPolicy(max_staleness, decay)
+    victim = n - 1
+    rng = np.random.default_rng(1234)
+    frame = wire.encode(
+        rng.standard_normal(d).astype(np.float32), wire_dtype
+    )
+
+    def open_mesh(delay_ms=0):
+        hosts = [f"127.0.0.1:{p}" for p in _ports(n)]
+        procs = {
+            k: _spawn_follow(
+                k, hosts, d, wire_dtype,
+                delay_ms if k == victim else 0,
+            )
+            for k in range(1, n)
+        }
+        ex = PeerExchange(0, hosts, connect_retry_ms=120_000)
+        for r in range(1, n):  # follow children hello rank 0 only
+            ex.read_latest(r, 0, timeout_ms=120_000)
+        return hosts, procs, ex
+
+    def close_mesh(procs, ex):
+        try:
+            ex.publish(_STOP_ROUND, b"", to=list(procs))
+        except OSError:
+            pass
+        ex.close()
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGCONT)  # un-freeze partitions
+                except OSError:
+                    pass
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # Fault-free baseline round (sync, no delay) — the '10x' anchor.
+    hosts, procs, ex = open_mesh()
+    try:
+        baseline_s, step = _sync_follow_rounds(
+            ex, list(range(1, n)), frame, max(5, rounds // 4), 1
+        )
+    finally:
+        close_mesh(procs, ex)
+    if not straggler_ms:
+        straggler_ms = max(20, int(baseline_s * 1e4))  # 10x, >= 20 ms
+
+    hub = tele_hub_lib.MetricsHub(num_ranks=n, meta={
+        "tag": "exchange-bench-scenario", "scenario": scenario,
+    })
+    tele_hub_lib.install(hub)
+    sync_best = async_best = None
+    tau_max = 0
+    presence = {}
+    try:
+        if scenario == "straggler":
+            hosts, procs, ex = open_mesh(delay_ms=straggler_ms)
+            collector = ex.round_collector(
+                list(range(1, n)), transform=_decode_tf
+            )
+            try:
+                step = 1
+                for _ in range(max(1, trials)):
+                    # Few sync rounds: each costs ~straggler_ms by
+                    # construction; the async segment then runs at the
+                    # fast ranks' pace with the victim's frame reused.
+                    sync_s, step = _sync_follow_rounds(
+                        ex, list(range(1, n)), frame,
+                        max(3, rounds // 6), step,
+                    )
+                    async_s, step, tmax, pres = _async_follow_rounds(
+                        ex, collector, n - 1, frame, rounds, step, policy,
+                    )
+                    sync_best = min(sync_best or sync_s, sync_s)
+                    async_best = min(async_best or async_s, async_s)
+                    tau_max = max(tau_max, tmax)
+                    for k, v in pres.items():
+                        presence[k] = presence.get(k, 0) + v
+            finally:
+                collector.close()
+                close_mesh(procs, ex)
+        else:
+            # churn / partition: async only, full q = n - 1 with the
+            # degrade-to-q-2 fallback — the victim stays IN the quorum
+            # while merely stale (its discount deficit feeds suspicion),
+            # drops out when its frames expire past the cutoff, and
+            # re-enters when it publishes again.
+            hosts, procs, ex = open_mesh(delay_ms=0)
+            collector = ex.round_collector(
+                list(range(1, n)), transform=_decode_tf
+            )
+
+            # Pace the rounds at >= 20 ms so the fault windows span real
+            # time: the victim's staleness must actually climb past the
+            # cutoff (exclusion) and recover (re-admission) — at the raw
+            # sub-ms gather pace the whole outage would fit in one frame.
+            pace_s = max(0.02, baseline_s)
+
+            def on_round(r):
+                time.sleep(pace_s)
+                if scenario == "churn":
+                    if r == rounds // 3:
+                        procs[victim].kill()
+                        procs[victim].wait(timeout=30)
+                    elif r == 2 * rounds // 3:
+                        # JOIN: a fresh process on the same rank/port
+                        # (re-admit = re-appearing in the admissible set;
+                        # in the cluster driver the rejoined worker also
+                        # re-reads its shard — re-admit becomes re-shard).
+                        procs[victim] = _spawn_follow(
+                            victim, hosts, d, wire_dtype
+                        )
+                elif scenario == "partition":
+                    if r == rounds // 3:
+                        procs[victim].send_signal(signal.SIGSTOP)
+                    elif r == 2 * rounds // 3:
+                        procs[victim].send_signal(signal.SIGCONT)
+
+            try:
+                async_best, step, tau_max, presence = _async_follow_rounds(
+                    ex, collector, n - 1, frame, rounds, 1, policy,
+                    on_round=on_round, q_min=n - 2,
+                    soft_timeout_ms=int(
+                        max(2_000, policy.max_staleness * pace_s * 1e3)
+                    ),
+                )
+            finally:
+                collector.close()
+                close_mesh(procs, ex)
+    finally:
+        tele_hub_lib.uninstall()
+    susp = hub.suspicion()
+    stale = hub.staleness_stats()
+    row = {
+        "mode": "scenario", "scenario": scenario, "n": n, "d": d,
+        "wire": wire_dtype, "rounds": rounds, "trials": trials,
+        "baseline_round_s": round(baseline_s, 6),
+        "straggler_ms": int(straggler_ms),
+        "sync_round_s": None if sync_best is None else round(sync_best, 6),
+        "async_round_s": (
+            None if async_best is None else round(async_best, 6)
+        ),
+        "speedup": (
+            None if not (sync_best and async_best)
+            else round(sync_best / async_best, 3)
+        ),
+        "max_staleness": policy.max_staleness, "decay": policy.decay,
+        "max_staleness_seen": int(tau_max),
+        "victim_rank": victim,
+        "victim_quorums": int(presence.get(victim, 0)),
+        "suspicion": (
+            None if susp is None
+            else [round(float(s), 6) for s in susp]
+        ),
+        "staleness_mean": None if stale is None else round(stale["mean"], 4),
+        "peak_rss_bytes": peak_rss_bytes(),
+    }
+    return row
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="host-plane exchange/wire-codec benchmark"
@@ -264,6 +587,25 @@ def main(argv=None):
                         "per wire dtype (the BASELINE.md row)")
     p.add_argument("--e2e_workers", type=int, default=4)
     p.add_argument("--e2e_iters", type=int, default=40)
+    p.add_argument("--scenario", nargs="*", default=None,
+                   choices=["straggler", "churn", "partition"],
+                   help="async-plane scenario harness cells (DESIGN.md "
+                        "§14): per (n, d, wire) run the named scenarios "
+                        "over follow-mode children — straggler A/Bs sync "
+                        "vs bounded-staleness round rate, churn and "
+                        "partition drive membership faults against "
+                        "telemetry suspicion")
+    p.add_argument("--straggler_ms", type=int, default=0,
+                   help="injected victim delay for --scenario straggler; "
+                        "0 (default) auto-derives 10x the measured "
+                        "fault-free round — the EXCHBENCH_r02 acceptance "
+                        "shape")
+    p.add_argument("--max_staleness", type=int, default=32,
+                   help="bounded-staleness hard cutoff for the scenario "
+                        "gathers (rounds)")
+    p.add_argument("--decay", type=float, default=0.9,
+                   help="per-round staleness discount for the scenario "
+                        "gathers")
     p.add_argument("--json", type=str, default=None,
                    help="dump results (+ the schema-versioned telemetry "
                         "JSONL twin at the same path with a .jsonl "
@@ -273,6 +615,10 @@ def main(argv=None):
     p.add_argument("--hosts", type=str, default=None, help=argparse.SUPPRESS)
     p.add_argument("--d", type=int, default=None, help=argparse.SUPPRESS)
     p.add_argument("--child_wire", type=str, default="f32",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--child_mode", type=str, default="paced",
+                   choices=["paced", "follow"], help=argparse.SUPPRESS)
+    p.add_argument("--child_delay_ms", type=int, default=0,
                    help=argparse.SUPPRESS)
     args = p.parse_args(argv)
     if args.child is not None:
@@ -291,6 +637,24 @@ def main(argv=None):
                     f"  {row['wire_bytes_per_step']:>12} B/step",
                     flush=True,
                 )
+    for scenario in args.scenario or ():
+        for n in args.ns:
+            for d in args.ds:
+                for w in args.wire:
+                    row = bench_scenario(
+                        scenario, n, d, w, args.rounds, args.trials,
+                        args.straggler_ms, args.max_staleness, args.decay,
+                    )
+                    results.append(row)
+                    print(
+                        f"scenario={scenario} n={n} d={d} wire={w} "
+                        f"sync={row['sync_round_s']} "
+                        f"async={row['async_round_s']} "
+                        f"speedup={row['speedup']} "
+                        f"tau_max={row['max_staleness_seen']} "
+                        f"suspicion={row['suspicion']}",
+                        flush=True,
+                    )
     if args.e2e:
         import tempfile
 
@@ -318,6 +682,23 @@ def main(argv=None):
                         round_s=row["round_s"],
                         wire_bytes_per_step=row["wire_bytes_per_step"],
                         rounds=row["rounds"], trials=row["trials"],
+                        peak_rss_bytes=row["peak_rss_bytes"],
+                    ))
+                elif row["mode"] == "scenario":
+                    exp.write(exporters.make_record(
+                        "exchange_bench",
+                        n=row["n"], d=row["d"], wire=row["wire"],
+                        scenario=row["scenario"],
+                        straggler_ms=row["straggler_ms"],
+                        sync_round_s=row["sync_round_s"],
+                        async_round_s=row["async_round_s"],
+                        speedup=row["speedup"],
+                        max_staleness=row["max_staleness"],
+                        max_staleness_seen=row["max_staleness_seen"],
+                        victim_rank=row["victim_rank"],
+                        suspicion=row["suspicion"],
+                        rounds=row["rounds"], trials=row["trials"],
+                        peak_rss_bytes=row["peak_rss_bytes"],
                     ))
                 else:
                     exp.write(exporters.make_record(

@@ -63,6 +63,15 @@ def main(argv=None):
         return cluster.run(args)
     assert args.fw * 2 < args.num_workers
     assert args.fps * 2 < args.num_ps or args.fps == 0
+    if getattr(args, "async_agg", False):
+        from ..utils import tools
+
+        tools.warning(
+            "[byzsgd] --async on the on-mesh topology is not emulated "
+            "(the in-graph staleness emulation lives on aggregathor; "
+            "cluster MSMW deployments support --async for real) — "
+            "running round-synchronous"
+        )
     return common.train(
         args,
         topology=byzsgd,

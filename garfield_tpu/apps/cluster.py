@@ -66,7 +66,7 @@ from jax.flatten_util import ravel_pytree
 from ..aggregators import gars
 from ..parallel import core
 from ..telemetry import hub as tele_hooks
-from ..utils import multihost, tools, wire
+from ..utils import multihost, rounds, tools, wire
 from ..utils.exchange import PeerExchange
 from . import common
 
@@ -329,6 +329,95 @@ def _frame_transform(split, stats=None, pass_empty=False):
         return head, tail
 
     return transform
+
+
+def _cancel_wait(wait_fn):
+    """Retire a pre-registered exchange harvest a role will never consume
+    (shutdown, catch-up jump, membership change): without the cancel its
+    waiter threads linger until the deadline or ``close()`` — the
+    lifecycle leak tests/test_exchange.py pins."""
+    if wait_fn is not None and hasattr(wait_fn, "cancel"):
+        wait_fn.cancel()
+
+
+def _async_gradient_quorum(collector, i, q, policy, republish, timeout_ms,
+                           who):
+    """The bounded-staleness twin of ``_gradient_quorum`` (DESIGN.md §14):
+    admissible frames for round ``i`` from the persistent round-tagged
+    collector — stale frames within ``policy.max_staleness`` are REUSED,
+    so the round rate decouples from the slowest rank; the collector's
+    freshness floor (at least one new arrival per harvest) stops the PS
+    from free-running on cached data. Codec rejects are Byzantine ban
+    evidence exactly as on the synchronous path: the rank's watcher is
+    retired (``remove_peer`` — the membership-change form of the waiter
+    lifecycle) and the gather retries over the survivors. Returns
+    ``{rank: (tag, (grad_row, stats_row))}``.
+    """
+    attempts = 0
+    while True:
+        try:
+            got = collector.gather(
+                i, q, max_staleness=policy.max_staleness,
+                timeout_ms=timeout_ms,
+            )
+        except TimeoutError:
+            attempts += 1
+            if attempts >= 3:
+                raise
+            tools.warning(
+                f"[{who}] round {i} admissible quorum timed out; "
+                f"re-publishing the model (attempt {attempts})"
+            )
+            tele_hooks.emit_event(
+                "quorum_retry", who=who, step=int(i), attempt=attempts
+            )
+            republish()
+            continue
+        bad = [k for k in got if isinstance(got[k][1], Exception)]
+        if not bad:
+            return got
+        for k in bad:
+            exc = got[k][1]
+            tools.warning(
+                f"[{who}] worker rank {k} sent a gradient frame that "
+                f"failed the wire codec ({exc}); excluding it from "
+                "all future quorums"
+            )
+            tele_hooks.emit_event(
+                "quorum_exclusion", who=who, step=int(i), rank=int(k),
+                got_bytes=int(getattr(exc, "nbytes", -1)),
+                why=str(exc),
+            )
+            collector.remove_peer(k)
+        if len(collector.peers()) < q:
+            raise SystemExit(
+                f"only {len(collector.peers())} well-formed workers "
+                f"remain but the quorum needs q={q}; aborting"
+            )
+
+
+def _staleness_quorum(got, i, q, policy, worker_ranks, who):
+    """Deterministic freshest-q composition + weights: sort the
+    admissible frames by (staleness, rank) — at ``max_staleness 0``
+    every tag equals ``i`` and this is exactly the synchronous path's
+    lowest-q-ranks composition — and derive the discount weights via the
+    shared policy (utils/rounds.py). Emits the per-round ``staleness``
+    telemetry event (schema v4: per-rank staleness + weights, folded
+    into suspicion alongside exclusions). Returns
+    ``(quorum_ranks, taus, weights)``."""
+    quorum = sorted(got, key=lambda k: (i - got[k][0], k))[:q]
+    taus = np.array([max(0, i - got[k][0]) for k in quorum], np.int64)
+    w = np.asarray(policy.weights(taus), np.float32)
+    if tele_hooks.current() is not None:
+        base = worker_ranks[0]
+        tele_hooks.emit_event(
+            "staleness", who=who, step=int(i),
+            ranks=[int(k - base) for k in quorum],
+            staleness=[int(t) for t in taus],
+            weights=[round(float(x), 6) for x in w],
+            reused=int((taus > 0).sum()),
+        )
+    return quorum, taus, w
 
 
 def _setup(args):
@@ -600,8 +689,7 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
             )
             return taps_lib.scatter(bundle, sel, n_w)
 
-    @jax.jit
-    def ps_update(flat_params, opt_state, grads_stack, step):
+    def _update_body(flat_params, opt_state, grads_stack, step):
         # f=0 with the default rule short-circuits to the mean, but an
         # explicitly requested rule (e.g. cclip, which is valid at f=0)
         # must run — silently averaging would fake the defense. Randomized
@@ -622,6 +710,19 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
         params = optax.apply_updates(params, updates)
         return ravel_pytree(params)[0], opt_state
 
+    ps_update = jax.jit(_update_body)
+    # Bounded-staleness update (DESIGN.md §14): the discount weights are
+    # composed into the stack BEFORE the GAR — Kardam's dampening, one
+    # row-scale multiply — so any registered rule aggregates the weighted
+    # rows. A fully-fresh quorum (all weights exactly 1.0) dispatches
+    # ps_update instead: same program as the synchronous path, which is
+    # the --max_staleness 0 bitwise-equality contract.
+    ps_update_weighted = jax.jit(
+        lambda fp, ost, stack, w, step: _update_body(
+            fp, ost, stack * w[:, None], step
+        )
+    )
+
     def acc_eval(state_flat):
         return parallel.compute_accuracy(
             (unravel(state_flat), bn_unravel(jnp.asarray(bn_mean))),
@@ -641,6 +742,16 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
     wire_stats = _WireStats("cluster-ps")
     split = (flat.size, bn_elems)
     grad_tf = _frame_transform(split, wire_stats)
+    # Bounded-staleness async mode (--async; DESIGN.md §14): ONE
+    # persistent round-tagged collector replaces the per-round
+    # collect_begin registrations — its multi-round watchers latch every
+    # worker frame (eagerly decoded + device-staged, same transform) and
+    # ``gather`` reuses admissible stale frames instead of blocking
+    # re-collects.
+    policy = rounds.resolve(args)
+    collector = None
+    if policy is not None:
+        collector = ex.round_collector(worker_ranks, transform=grad_tf)
     # PS-side checkpoint/resume (utils/checkpoint.py — the deliberate
     # upgrade over the reference, which has none; the on-mesh analog with
     # sharded TrainState + bit-exact rng replay lives in common.train).
@@ -671,75 +782,120 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
             start_iter = last_saved = int(step)
             print(f"[cluster-ps] resumed from step {start_iter}", flush=True)
     grad_wait = None
-    if start_iter < args.num_iter:
-        grad_wait = ex.collect_begin(
-            start_iter, q, timeout_ms=timeout_ms, peers=good_ranks,
-            transform=grad_tf,
-        )
-    for i in range(start_iter, args.num_iter):
-        t_step = time.time()
-        frame = _encode_frame(
-            [flat] + ([bn_mean] if bn_elems else []),
-            wire_stats, fanout=len(worker_ranks),
-        )
-        ex.publish(i, frame, to=worker_ranks)
-        got, good_ranks = _gradient_quorum(
-            ex, i, q, good_ranks, split,
-            lambda: ex.publish(i, frame, to=worker_ranks),
-            timeout_ms, "cluster-ps", stats=wire_stats, wait_fn=grad_wait,
-        )
-        # Overlap (DESIGN.md §11): the NEXT round's collect is registered
-        # before this round's device update/eval, so fast workers'
-        # next-round gradients are latched + decoded + device-staged by
-        # the waiter threads while the PS is still updating/evaluating.
-        if i + 1 < args.num_iter:
+    try:
+        if collector is None and start_iter < args.num_iter:
             grad_wait = ex.collect_begin(
-                i + 1, q, timeout_ms=timeout_ms, peers=good_ranks,
+                start_iter, q, timeout_ms=timeout_ms, peers=good_ranks,
                 transform=grad_tf,
             )
-        # Deterministic composition: of the >= q arrivals, aggregate the q
-        # lowest ranks (the GAR's n is static under jit). Rows arrive
-        # pre-decoded (and device-staged) from the waiter threads.
-        quorum = sorted(got)[:q]
-        stack = jnp.stack([got[k][0] for k in quorum])
-        if bn_elems:
-            # Robust coordinate-wise aggregation of the quorum's BatchNorm
-            # stats (trim f per side; plain mean at f=0 == the on-mesh
-            # core.mean_model_state) — see _robust_stats.
-            bn_mean = _robust_stats(
-                np.stack([got[k][1] for k in quorum]), f
+        for i in range(start_iter, args.num_iter):
+            t_step = time.time()
+            frame = _encode_frame(
+                [flat] + ([bn_mean] if bn_elems else []),
+                wire_stats, fanout=len(worker_ranks),
             )
-        flat_dev, opt_state = ps_update(
-            flat_dev, opt_state, stack,
-            jnp.asarray(i, jnp.int32),
-        )
-        flat = np.asarray(flat_dev, np.float32)  # next step's publication
-        wire_stats.flush(i)
-        if tele_hub is not None:
-            # Worker index = exchange rank - first worker rank; the q
-            # quorum members are the observed ranks this step.
-            sel = jnp.asarray(
-                [k - worker_ranks[0] for k in quorum], jnp.int32
-            )
-            tele_hub.record_step(
-                i, tap=tap_fn(stack, sel),
-                step_time_s=time.time() - t_step,
-            )
-        losses_seen = i + 1
-        if ckpt and args.checkpoint_freq and (i + 1) % args.checkpoint_freq == 0:
-            ckpt.save(i + 1, {
-                "flat": flat,
-                "opt_state": jax.tree.map(np.asarray, opt_state),
-                **({"bn": bn_mean} if bn_elems else {}),
-            })
-            last_saved = i + 1
-        if args.acc_freq and i % args.acc_freq == 0:
-            acc = acc_eval(flat_dev)
-            print(
-                f"Step: {i} Accuracy: {acc:.4f} "
-                f"Time: {time.time() - t0:.1f}",
-                flush=True,
-            )
+            ex.publish(i, frame, to=worker_ranks)
+            w = None
+            if collector is not None:
+                # Bounded staleness (DESIGN.md §14): admissible frames —
+                # freshest per worker, reused across rounds within the
+                # cutoff — instead of an exact-round quorum; the freshest
+                # q compose the aggregate with decayed weights.
+                got = _async_gradient_quorum(
+                    collector, i, q, policy,
+                    lambda: ex.publish(i, frame, to=worker_ranks),
+                    timeout_ms, "cluster-ps",
+                )
+                quorum, taus, w = _staleness_quorum(
+                    got, i, q, policy, worker_ranks, "cluster-ps"
+                )
+                rows = {k: got[k][1] for k in quorum}
+            else:
+                got, good_ranks = _gradient_quorum(
+                    ex, i, q, good_ranks, split,
+                    lambda: ex.publish(i, frame, to=worker_ranks),
+                    timeout_ms, "cluster-ps", stats=wire_stats,
+                    wait_fn=grad_wait,
+                )
+                # Overlap (DESIGN.md §11): the NEXT round's collect is
+                # registered before this round's device update/eval, so
+                # fast workers' next-round gradients are latched +
+                # decoded + device-staged by the waiter threads while the
+                # PS is still updating/evaluating.
+                grad_wait = None
+                if i + 1 < args.num_iter:
+                    grad_wait = ex.collect_begin(
+                        i + 1, q, timeout_ms=timeout_ms, peers=good_ranks,
+                        transform=grad_tf,
+                    )
+                # Deterministic composition: of the >= q arrivals,
+                # aggregate the q lowest ranks (the GAR's n is static
+                # under jit). Rows arrive pre-decoded (and device-staged)
+                # from the waiter threads.
+                quorum = sorted(got)[:q]
+                rows = {k: got[k] for k in quorum}
+            stack = jnp.stack([rows[k][0] for k in quorum])
+            if bn_elems:
+                # Robust coordinate-wise aggregation of the quorum's
+                # BatchNorm stats (trim f per side; plain mean at f=0 ==
+                # the on-mesh core.mean_model_state) — see _robust_stats.
+                # Async mode reuses the same quorum rows (stats staleness
+                # rides the same cutoff; the trim bounds a stale row like
+                # any other outlier).
+                bn_mean = _robust_stats(
+                    np.stack([rows[k][1] for k in quorum]), f
+                )
+            if w is not None and not np.all(w == 1.0):
+                stack_gar = stack * jnp.asarray(w)[:, None]
+                flat_dev, opt_state = ps_update_weighted(
+                    flat_dev, opt_state, stack, jnp.asarray(w),
+                    jnp.asarray(i, jnp.int32),
+                )
+            else:
+                # Fully-fresh quorum (or synchronous mode): the
+                # unweighted program — at --max_staleness 0 this is the
+                # bitwise synchronous trajectory.
+                stack_gar = stack
+                flat_dev, opt_state = ps_update(
+                    flat_dev, opt_state, stack,
+                    jnp.asarray(i, jnp.int32),
+                )
+            flat = np.asarray(flat_dev, np.float32)  # next publication
+            wire_stats.flush(i)
+            if tele_hub is not None:
+                # Worker index = exchange rank - first worker rank; the q
+                # quorum members are the observed ranks this step. The
+                # tap audits the rows the rule consumed — staleness-
+                # weighted included.
+                sel = jnp.asarray(
+                    [k - worker_ranks[0] for k in quorum], jnp.int32
+                )
+                tele_hub.record_step(
+                    i, tap=tap_fn(stack_gar, sel),
+                    step_time_s=time.time() - t_step,
+                )
+            losses_seen = i + 1
+            if (ckpt and args.checkpoint_freq
+                    and (i + 1) % args.checkpoint_freq == 0):
+                ckpt.save(i + 1, {
+                    "flat": flat,
+                    "opt_state": jax.tree.map(np.asarray, opt_state),
+                    **({"bn": bn_mean} if bn_elems else {}),
+                })
+                last_saved = i + 1
+            if args.acc_freq and i % args.acc_freq == 0:
+                acc = acc_eval(flat_dev)
+                print(
+                    f"Step: {i} Accuracy: {acc:.4f} "
+                    f"Time: {time.time() - t0:.1f}",
+                    flush=True,
+                )
+    finally:
+        # Waiter lifecycle (tests/test_exchange.py): a registration left
+        # pending by an abort must not leak its threads until close().
+        _cancel_wait(grad_wait)
+        if collector is not None:
+            collector.close()
     # Stop sentinel: an empty frame at step num_iter tells every worker
     # (including stragglers that skipped rounds) training is over.
     ex.publish(args.num_iter, b"", to=worker_ranks)
@@ -1083,8 +1239,7 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
             )
             return taps_lib.scatter(bundle, sel, n_w)
 
-    @jax.jit
-    def ps_update(flat_params, opt_state, grads_stack, step):
+    def _update_body(flat_params, opt_state, grads_stack, step):
         if f or args.gar != "average":
             agg = gar.unchecked(
                 grads_stack, f=f,
@@ -1099,6 +1254,16 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
         params = optax.apply_updates(params, updates)
         return ravel_pytree(params)[0], opt_state
 
+    ps_update = jax.jit(_update_body)
+    # Staleness-weighted twin (DESIGN.md §14) — see _run_ps: weights
+    # compose into the stack before the GAR; all-fresh quorums dispatch
+    # the unweighted program (the --max_staleness 0 bitwise contract).
+    ps_update_weighted = jax.jit(
+        lambda fp, ost, stack, w, step: _update_body(
+            fp, ost, stack * w[:, None], step
+        )
+    )
+
     t0 = time.time()
     flat = np.asarray(flat0, np.float32)
     flat_dev = jnp.asarray(flat)  # --num_iter 0: eval the init model
@@ -1107,6 +1272,15 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
     split = (flat.size, bn_elems)
     model_tf = _frame_transform(split, wire_stats)
     grad_tf = _frame_transform(split, wire_stats)
+    # --async (DESIGN.md §14): bounded staleness applies to the WORKER
+    # gradient plane only — the PS-replica model gather stays exact-round
+    # (the ByzSGD fps contract is an agreement over one round's models;
+    # mixing rounds there would let a lagging replica's stale model count
+    # as a live vote).
+    policy = rounds.resolve(args)
+    collector = None
+    if policy is not None:
+        collector = ex.round_collector(worker_ranks, transform=grad_tf)
     ckpt = None
     start_iter = last_saved = 0
     if args.checkpoint_dir:
@@ -1166,6 +1340,10 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
                 f"to round {lap.newest}"
             )
             i = lap.newest
+            # Abandoned-round registrations must not leak their waiter
+            # threads until the slots happen to advance past them.
+            _cancel_wait(model_wait)
+            _cancel_wait(grad_wait)
             model_wait = grad_wait = None
             continue
         model_wait = None  # consumed
@@ -1176,25 +1354,42 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
             # the old assignment here was dead, so replicas never actually
             # reconciled BN state).
             bn_plane = _robust_stats(models_bn, plane.fps)
-        got, good_ranks = _gradient_quorum(
-            ex, i, q, good_ranks, split,
-            lambda: ex.publish(i, frame, to=everyone),
-            timeout_ms, who, stats=wire_stats, wait_fn=grad_wait,
-        )
+        w = None
+        if collector is not None:
+            got = _async_gradient_quorum(
+                collector, i, q, policy,
+                lambda: ex.publish(i, frame, to=everyone),
+                timeout_ms, who,
+            )
+            quorum, taus, w = _staleness_quorum(
+                got, i, q, policy, worker_ranks, who
+            )
+            rows = {k: got[k][1] for k in quorum}
+        else:
+            got, good_ranks = _gradient_quorum(
+                ex, i, q, good_ranks, split,
+                lambda: ex.publish(i, frame, to=everyone),
+                timeout_ms, who, stats=wire_stats, wait_fn=grad_wait,
+            )
+            grad_wait = None
+            quorum = sorted(got)[:q]
+            rows = {k: got[k] for k in quorum}
         # Overlap (DESIGN.md §11): next round's planes registered before
         # the device update/eval — peer models and fast workers' gradients
-        # decode + stage while this replica still computes.
+        # decode + stage while this replica still computes. (The async
+        # gradient plane needs no registration: its collector watches
+        # every round persistently.)
         if i + 1 < args.num_iter:
             model_wait = ex.collect_begin(
                 i + 1, len(plane.ranks), timeout_ms=timeout_ms,
                 peers=plane.ranks, transform=model_tf,
             )
-            grad_wait = ex.collect_begin(
-                i + 1, q, timeout_ms=timeout_ms, peers=good_ranks,
-                transform=grad_tf,
-            )
-        quorum = sorted(got)[:q]
-        stack = jnp.stack([got[k][0] for k in quorum])
+            if collector is None:
+                grad_wait = ex.collect_begin(
+                    i + 1, q, timeout_ms=timeout_ms, peers=good_ranks,
+                    transform=grad_tf,
+                )
+        stack = jnp.stack([rows[k][0] for k in quorum])
         if bn_elems:
             # BN reconciliation mirrors the params: equal-weight blend of
             # the peer replicas' robust-aggregated stats (published next
@@ -1206,12 +1401,20 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
             # pmean over the ps axis, parallel/byzsgd.py, is the
             # limit-case of this blend).
             bn = 0.5 * (bn_plane + _robust_stats(
-                np.stack([got[k][1] for k in quorum]), f
+                np.stack([rows[k][1] for k in quorum]), f
             ))
-        flat_dev, opt_state = ps_update(
-            flat_dev, opt_state, stack,
-            jnp.asarray(i, jnp.int32),
-        )
+        if w is not None and not np.all(w == 1.0):
+            stack_gar = stack * jnp.asarray(w)[:, None]
+            flat_dev, opt_state = ps_update_weighted(
+                flat_dev, opt_state, stack, jnp.asarray(w),
+                jnp.asarray(i, jnp.int32),
+            )
+        else:
+            stack_gar = stack
+            flat_dev, opt_state = ps_update(
+                flat_dev, opt_state, stack,
+                jnp.asarray(i, jnp.int32),
+            )
         flat = np.asarray(flat_dev, np.float32)
         wire_stats.flush(i)
         if tele_hub is not None:
@@ -1219,7 +1422,7 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
                 [k - worker_ranks[0] for k in quorum], jnp.int32
             )
             tele_hub.record_step(
-                i, tap=tap_fn(stack, sel),
+                i, tap=tap_fn(stack_gar, sel),
             )
         losses_seen = i + 1
         if ckpt and args.checkpoint_freq and (i + 1) % args.checkpoint_freq == 0:
@@ -1241,6 +1444,13 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
                 flush=True,
             )
         i += 1
+    # Waiter lifecycle: retire anything the loop left registered (the
+    # exception paths fall through to run()'s ex.close(), whose close
+    # sentinel wakes and joins every watcher before the register frees).
+    _cancel_wait(model_wait)
+    _cancel_wait(grad_wait)
+    if collector is not None:
+        collector.close()
     acc = parallel.compute_accuracy(
         (unravel(flat_dev), bn_unravel(jnp.asarray(bn))),
         lambda s, x: eval_fn(s[0], s[1], x),
@@ -1296,6 +1506,22 @@ def _run_learn(args):
     byzServer analog). A SIGKILLed node simply stops publishing and every
     survivor's wait-n-f quorum flows around it.
     """
+    if rounds.resolve(args) is not None:
+        # LEARN multiplexes BOTH planes (gradients at 2i+2, models at
+        # 2i+3) on one last-writer-wins register slot per peer, so a
+        # round-tagged multi-round watcher cannot hold a stale gradient
+        # once its publisher gossips the model — and decentralized
+        # bounded staleness additionally needs the agreement rounds to
+        # keep honest models from drifting. Fail loudly instead of
+        # silently running synchronous (DESIGN.md §14 scopes the async
+        # plane to the PS topologies; LEARN's per-node wait-n-f already
+        # flows around stragglers).
+        raise SystemExit(
+            "--async is not supported on LEARN node deployments (the "
+            "gossip register multiplexes both planes per peer; see "
+            "DESIGN.md §14) — run the SSMW/MSMW cluster shapes async, "
+            "or rely on LEARN's built-in wait-n-f straggler tolerance"
+        )
     cfg = multihost.ClusterConfig(args.cluster)
     if args.task:
         ttype, _, tidx = args.task.partition(":")
@@ -1602,8 +1828,12 @@ def _run_learn(args):
                 # Dropped out of the quorum flow: the reference's pull
                 # loops retry a bounded number of times then exit
                 # gracefully (server.py:138-141, ps.py:84-88); survivors'
-                # wait-n-f treats this node as crashed from here on.
+                # wait-n-f treats this node as crashed from here on. The
+                # round's model-plane registration is never harvested —
+                # cancel it so its waiter threads retire now, not at
+                # close() (the waiter-lifecycle contract).
                 dropped_at = i
+                _cancel_wait(model_wait)
                 tools.warning(
                     f"[{who}] lost the round-{i} gradient quorum; exiting "
                     "as a dropout (reference bounded-retry semantics)"
@@ -1788,7 +2018,90 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
     ms = ms0
     loss = None
     steps_done = 0
+    refreshes = 0
     i = 0
+    # Bounded-staleness async mode (--async, DESIGN.md §14): the worker
+    # side is publish-and-continue — it never barriers on its gradient
+    # entering a quorum, and while the next model broadcast is pending it
+    # REFRESHES its published frame (same round tag — staleness is set by
+    # the model round used — fresh batch/key), so the PS's stale-frame
+    # reuse sees this rank's newest data instead of its oldest.
+    policy = rounds.resolve(args)
+    async_mode = policy is not None and not multi_ps
+    straggle_s = max(0, int(getattr(args, "straggler_ms", 0) or 0)) / 1e3
+    refresh_ms = min(timeout_ms, 2_000)
+    prev = None  # (step, flat_params) of the newest model seen
+    refresh_r = 0
+
+    def compute_and_publish(step, flat_params, r=0):
+        """One gradient compute + publish for model round ``step``.
+
+        ``r > 0`` marks an async REFRESH: the batch index and RNG fold in
+        the refresh counter so the republished frame carries NEW data
+        (the register is last-writer-wins — it replaces this rank's older
+        frame at the same tag). ``r == 0`` derivations are EXACTLY the
+        synchronous ones, so non-refresh trajectories are untouched (the
+        --max_staleness 0 bitwise contract). ``--straggler_ms`` injects
+        the scenario harness's reproducible slow-rank delay just before
+        the publish."""
+        nonlocal ms, mom, loss
+        if atk_kind == "cohort":
+            # Colluding attacker (byzWorker.py:114-125): compute the
+            # cohort's honest gradients locally on DISTINCT batches of
+            # the attacker's own shard, publish the collusion statistic.
+            # In a --worker_momentum deployment the honest workers
+            # publish EMA momenta, so the attacker simulates its
+            # cohort's MOMENTA and hides inside their (shrunken)
+            # variance — the on-mesh semantics and the strongest form of
+            # the attack the cclip defense is built for.
+            rows = []
+            for j in range(atk_cohort):
+                o = step * atk_cohort + j
+                key = jax.random.fold_in(base_key, o)
+                if r:
+                    key = jax.random.fold_in(key, 1_000_003 + r)
+                gj, loss_, ms_new = worker_grad(
+                    flat_params, ms, my_xs[(o + r) % num_batches],
+                    my_ys[(o + r) % num_batches], key,
+                )
+                loss, ms = loss_, ms_new
+                rows.append(np.asarray(gj, np.float32))
+            rows = np.stack(rows)
+            if beta is not None:
+                mom = (1.0 - beta) * rows + beta * (
+                    0.0 if mom is None else mom
+                )
+                rows = mom.astype(np.float32)
+            g = attack(rows)
+        else:
+            key = jax.random.fold_in(base_key, step)
+            if r:
+                key = jax.random.fold_in(key, 1_000_003 + r)
+            b = (step + r) % num_batches
+            g, loss_, ms_new = worker_grad(
+                flat_params, ms, my_xs[b], my_ys[b], key,
+            )
+            loss, ms = loss_, ms_new
+            g = np.asarray(g, np.float32)
+            if beta is not None:
+                mom = (1.0 - beta) * g + beta * (0.0 if mom is None else mom)
+                g = mom.astype(np.float32)
+            if attack is not None:
+                g = attack(g)
+        out_parts = [g]
+        if bn_elems:
+            # Both deployment shapes ship [grad || stats] (MSMW BN plane,
+            # r5); the PS robust-aggregates the stats segment.
+            out_parts.append(np.asarray(ravel_pytree(ms)[0], np.float32))
+        if straggle_s:
+            time.sleep(straggle_s)  # injected slow rank (scenario knob)
+        targets = plane.all_ranks if multi_ps else ps_ranks
+        ex.publish(
+            step,
+            _encode_frame(out_parts, wire_stats, fanout=len(targets)),
+            to=targets,
+        )
+
     # Overlap (DESIGN.md §11): the model read is registered BEFORE the
     # local gradient compute each round, so the next model frame is
     # latched + decoded + device-staged by the watcher thread while this
@@ -1831,7 +2144,36 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
                     _robust_stats(models_bn, plane.fps)
                 ))
         else:
-            step, payload = model_wait(timeout_ms=timeout_ms)
+            if async_mode and prev is not None:
+                # Publish-and-continue (DESIGN.md §14): poll for the next
+                # broadcast in short chunks; while none arrives, refresh
+                # the published frame from the stale model on a new batch
+                # — the PS's bounded-staleness reuse then aggregates this
+                # rank's NEWEST data, and a straggling PS cannot idle the
+                # worker. The full timeout budget still bounds the wait.
+                waited = 0.0
+                while True:
+                    try:
+                        step, payload = model_wait(timeout_ms=refresh_ms)
+                        break
+                    except TimeoutError:
+                        waited += refresh_ms
+                        if waited >= timeout_ms:
+                            raise
+                        if policy.max_staleness > 0:
+                            refresh_r += 1
+                            refreshes += 1
+                            compute_and_publish(
+                                prev[0], prev[1], r=refresh_r
+                            )
+                            wire_stats.flush(prev[0])
+                        # The timed-out harvest retired its watcher;
+                        # re-register before the next poll.
+                        model_wait = ex.read_latest_begin(
+                            0, prev[0] + 1, transform=model_tf
+                        )
+            else:
+                step, payload = model_wait(timeout_ms=timeout_ms)
             if step >= args.num_iter or payload == b"":
                 break  # PS's stop sentinel (empty frame at num_iter)
             if isinstance(payload, Exception):
@@ -1855,53 +2197,9 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
                 # Adopt the PS's mean BatchNorm statistics — the cluster
                 # twin of the on-mesh core.mean_model_state sync.
                 ms = bn_unravel(jnp.asarray(bn_seg))
-        if atk_kind == "cohort":
-            # Colluding attacker (byzWorker.py:114-125): compute the
-            # cohort's honest gradients locally on DISTINCT batches of the
-            # attacker's own shard, publish the collusion statistic. In a
-            # --worker_momentum deployment the honest workers publish EMA
-            # momenta, so the attacker simulates its cohort's MOMENTA and
-            # hides inside their (shrunken) variance — the on-mesh
-            # semantics (the attack poisons the EMA'd stack) and the
-            # strongest form of the attack the cclip defense is built for.
-            rows = []
-            for j in range(atk_cohort):
-                b = (step * atk_cohort + j) % num_batches
-                gj, loss, ms = worker_grad(
-                    flat_params, ms, my_xs[b], my_ys[b],
-                    jax.random.fold_in(base_key, step * atk_cohort + j),
-                )
-                rows.append(np.asarray(gj, np.float32))
-            rows = np.stack(rows)
-            if beta is not None:
-                mom = (1.0 - beta) * rows + beta * (
-                    0.0 if mom is None else mom
-                )
-                rows = mom.astype(np.float32)
-            g = attack(rows)
-        else:
-            b = step % num_batches
-            g, loss, ms = worker_grad(
-                flat_params, ms,
-                my_xs[b], my_ys[b], jax.random.fold_in(base_key, step),
-            )
-            g = np.asarray(g, np.float32)
-            if beta is not None:
-                mom = (1.0 - beta) * g + beta * (0.0 if mom is None else mom)
-                g = mom.astype(np.float32)
-            if attack is not None:
-                g = attack(g)
-        out_parts = [g]
-        if bn_elems:
-            # Both deployment shapes ship [grad || stats] (MSMW BN plane,
-            # r5); the PS robust-aggregates the stats segment.
-            out_parts.append(np.asarray(ravel_pytree(ms)[0], np.float32))
-        targets = plane.all_ranks if multi_ps else ps_ranks
-        ex.publish(
-            step,
-            _encode_frame(out_parts, wire_stats, fanout=len(targets)),
-            to=targets,
-        )
+            prev = (step, flat_params)
+            refresh_r = 0
+        compute_and_publish(step, flat_params)
         wire_stats.flush(step)
         if (mom_path is not None and mom is not None
                 and args.checkpoint_freq
@@ -1917,8 +2215,13 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
                 f"Worker {windex} loss {step}: {float(loss):.6f}", flush=True
             )
         i = step + 1
+    # Waiter lifecycle: the loop's last registration (the round past the
+    # final one, or the sentinel path's re-read) is never harvested —
+    # retire it now instead of at close() (tests/test_exchange.py).
+    _cancel_wait(model_wait)
     summary = {
         "steps": steps_done,
+        **({"refreshes": refreshes} if async_mode else {}),
         "final_loss": float(loss) if loss is not None else None,
     }
     print(json.dumps({"tag": f"cluster-worker-{windex}", **summary}),

@@ -65,6 +65,30 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
     a("--subset", type=int, default=None,
       help="Async wait-for-q emulation: aggregate a random q-subset "
            "of worker gradients each step (server.py:134-155).")
+    a("--async", dest="async_agg", action="store_true",
+      help="Bounded-staleness asynchronous aggregation (DESIGN.md §14): "
+           "the PS aggregates the freshest n-fw arrivals with staleness-"
+           "discounted weights and reuses admissible stale frames instead "
+           "of blocking on stragglers; workers publish-and-continue. In "
+           "--cluster mode this is the real host-plane protocol (SSMW/"
+           "MSMW); on-mesh it is the seeded in-graph emulation "
+           "(aggregathor topology). Off: round-synchronous (default).")
+    a("--max_staleness", type=int, default=None,
+      help="Hard staleness cutoff for --async, in rounds: a gradient "
+           "tagged more than this many rounds behind the PS is excluded "
+           "(weight 0). 0 = synchronous semantics (exact-round frames "
+           "only, bitwise-equal trajectory). Default: env "
+           "GARFIELD_MAX_STALENESS, else 4.")
+    a("--staleness_decay", type=float, default=None,
+      help="Per-round geometric discount for --async: a gradient tau "
+           "rounds stale enters the GAR scaled by decay**tau. Default: "
+           "env GARFIELD_STALENESS_DECAY, else 0.5.")
+    a("--straggler_ms", type=int, default=0,
+      help="Scenario-injection knob (the straggler half of the async "
+           "harness, exchange_bench --scenario): in cluster mode THIS "
+           "worker sleeps the given milliseconds after each gradient "
+           "compute before publishing — a reproducible 'slow rank'. "
+           "0 (default) disables; ignored on-mesh and on PS roles.")
     a("--granularity", type=str, default="model", choices=["model", "layer"],
       help="GAR over the whole flat gradient or per parameter tensor "
            "(Garfield_CC semantics).")

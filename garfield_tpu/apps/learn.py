@@ -73,6 +73,16 @@ def main(argv=None):
         # exists only in the cluster deployment.
         raise SystemExit("--model_gar requires --cluster (node deployment)")
     assert args.fw * 2 < args.num_workers or args.fw == 0
+    if getattr(args, "async_agg", False):
+        from ..utils import tools
+
+        tools.warning(
+            "[learn] --async is a PS-topology mode (SSMW/MSMW): LEARN's "
+            "gossip multiplexes both planes on one register slot per "
+            "peer, so bounded staleness does not apply — running "
+            "round-synchronous (its wait-n-f already flows around "
+            "stragglers)"
+        )
     return common.train(
         args,
         topology=learn,

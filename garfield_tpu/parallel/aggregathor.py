@@ -94,10 +94,15 @@ def _tree_path_ok(tree_path, subset, num_slots, granularity, gar,
 def _attack_then_aggregate(
     flat_stack, byz_mask, atk_key, sub_key, gar_key, *, attack,
     attack_params, gar, f, subset, gar_params, center=None,
+    row_weights=None,
 ):
     """Poison rows, optionally subsample (wait n-f), aggregate. Pure.
     ``gar_key`` seeds randomized rules (condense's Bernoulli mask);
-    ``center`` threads a stateful rule's carried v_0 (cclip)."""
+    ``center`` threads a stateful rule's carried v_0 (cclip);
+    ``row_weights`` is the bounded-staleness discount composed AFTER the
+    attack and the subset — the rows the rule consumes are exactly what
+    the host-plane PS aggregates: poisoned, quorum-selected, then
+    staleness-weighted (utils/rounds.py, DESIGN.md §14)."""
     n = flat_stack.shape[0]
     stack = apply_gradient_attack(
         attack, flat_stack, byz_mask, key=atk_key, **attack_params
@@ -105,6 +110,10 @@ def _attack_then_aggregate(
     if subset is not None and subset < n:
         sel = core.subset_indices(sub_key, n, subset)
         stack = stack[sel]
+        if row_weights is not None:
+            row_weights = row_weights[sel]
+    if row_weights is not None:
+        stack = (stack * row_weights[:, None]).astype(stack.dtype)
     extra = {} if center is None else {"center": center}
     return gar.unchecked(stack, f=f, key=gar_key, **gar_params, **extra)
 
@@ -130,6 +139,7 @@ def make_trainer(
     gar_params=None,
     num_iter=None,
     telemetry=False,
+    staleness=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the SSMW topology.
 
@@ -182,6 +192,22 @@ def make_trainer(
     momentum 0.9 but trains normally with momentum 0 at the
     gain-compensated lr (BASELINE.md TTA grid, the worker-momentum rows).
 
+    ``staleness`` is the in-graph EMULATION of the host plane's
+    bounded-staleness async mode (DESIGN.md §14) — the asynchrony analog
+    of the seeded ``subset`` emulation: a dict with ``max_staleness``
+    (hard cutoff, rounds), ``decay`` (geometric discount), and optional
+    ``taus`` (a FIXED per-rank staleness assignment — "rank r lags tau_r
+    rounds"; omitted, each step draws per-rank staleness uniformly from
+    ``[0, max_staleness]`` with a seeded key). The resulting weights
+    (``utils.rounds.staleness_weights`` — the same function the host
+    plane's PS applies) scale the post-attack rows before the GAR on
+    every dispatch path, composed into the folded-attack row scales on
+    Gram-form rules so ``fold.plan_for`` still applies. At
+    ``max_staleness=0`` (or an all-zero ``taus``) the emulation is
+    dropped entirely and the step program is the synchronous one —
+    trajectories are BITWISE equal, the emulated half of the
+    ``--max_staleness 0`` contract (tests/test_staleness.py).
+
     ``step_fn(state, x, y) -> (state, metrics)`` expects ``x``/``y`` with a
     leading ``num_workers`` axis, sharded over ``axis``; it is jit'd with
     replicated state output, so calling it in a loop keeps everything
@@ -228,6 +254,47 @@ def make_trainer(
     # (fold.plan_for).
     fold_plan = fold.plan_for(gar, attack, byz_mask, attack_params)
     byz_mask = jnp.asarray(byz_mask, dtype=bool)
+
+    # Bounded-staleness emulation (see docstring). Normalized here so the
+    # trivially-synchronous configs drop the machinery at BUILD time: the
+    # step program is then literally the synchronous one — the bitwise
+    # half of the --max_staleness 0 contract.
+    stale_ms = stale_decay = stale_weights_static = None
+    if staleness is not None:
+        import numpy as np
+
+        from ..utils import rounds as rounds_lib
+
+        st = dict(staleness)
+        stale_ms = int(st.pop(
+            "max_staleness", rounds_lib.DEFAULT_MAX_STALENESS
+        ))
+        stale_decay = float(st.pop("decay", rounds_lib.DEFAULT_DECAY))
+        taus = st.pop("taus", None)
+        if st:
+            raise ValueError(f"unknown staleness keys {sorted(st)}")
+        rounds_lib.StalenessPolicy(stale_ms, stale_decay)  # validate
+        if stale_ms == 0:
+            staleness = None  # all weights exactly 1: synchronous program
+        elif taus is not None:
+            taus = np.clip(np.asarray(taus, np.int64), 0, stale_ms)
+            if taus.shape != (num_workers,):
+                raise ValueError(
+                    f"staleness taus must have shape ({num_workers},), "
+                    f"got {taus.shape}"
+                )
+            stale_weights_static = rounds_lib.staleness_weights(
+                taus, decay=stale_decay, max_staleness=stale_ms
+            )
+            if np.all(stale_weights_static == 1.0):
+                staleness = None  # all-fresh schedule: same program
+        if (staleness is not None and fold_plan is not None
+                and gar.gram_select is None):
+            # Row weights compose with the fold only through the Gram
+            # (fold.folded_tree_aggregate row_weights); the other fold
+            # forms consume row values — route through the where-path,
+            # which weights rows explicitly.
+            fold_plan = None
 
     init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
     # Slot-fused gradient twin (models/slotfused.py) when eligible, else
@@ -308,9 +375,27 @@ def make_trainer(
         honest = (~byz_mask).astype(losses.dtype)
         mean_loss = jnp.sum(losses * honest) / jnp.sum(honest)
 
+        # Bounded-staleness weights (emulation; see docstring): fixed
+        # per-rank schedule, or a fresh seeded draw each step. The key is
+        # fold_in-derived (NOT an extra split) so synchronous configs'
+        # key derivation — and therefore every pinned trajectory — is
+        # untouched.
+        stale_w = None
+        if staleness is not None:
+            if stale_weights_static is not None:
+                stale_w = jnp.asarray(stale_weights_static)
+            else:
+                stale_taus = jax.random.randint(
+                    jax.random.fold_in(base, 0x57A1E),
+                    (num_workers,), 0, stale_ms + 1,
+                )
+                stale_w = rounds_lib.staleness_weights(
+                    stale_taus, decay=stale_decay, max_staleness=stale_ms
+                )
+
         agg_kwargs = dict(
             attack=attack, attack_params=attack_params, gar=gar, f=f,
-            subset=subset, gar_params=gar_params,
+            subset=subset, gar_params=gar_params, row_weights=stale_w,
         )
         center_kw = (
             {"center": state.gar_state} if gar.stateful_center else {}
@@ -332,16 +417,27 @@ def make_trainer(
                 # Folded attack: poison the Gram, never the rows — the raw
                 # per-leaf Grams keep fusing into the backward epilogue
                 # like the fault-free step (parallel/fold.py; 1.16x on the
-                # krum+lie north-star).
+                # krum+lie north-star). Staleness weights compose into the
+                # fold's row scales (row_weights), so the fast path
+                # survives the async emulation.
                 aggr_tree = fold.folded_tree_aggregate(
                     gar, fold_plan, grads, f=f, key=gar_key,
                     gar_params={**gar_params, **center_kw},
-                    subset_sel=sel,
+                    subset_sel=sel, row_weights=stale_w,
                 )
             else:
                 poisoned = apply_gradient_attack_tree(
                     attack, grads, byz_mask, key=atk_key, **attack_params
                 )
+                if stale_w is not None:
+                    # Weight the post-attack rows — what the host-plane
+                    # PS aggregates (poisoned arrivals, then discounted).
+                    poisoned = jax.tree.map(
+                        lambda l: (l * stale_w.reshape(
+                            (num_workers,) + (1,) * (l.ndim - 1)
+                        )).astype(l.dtype),
+                        poisoned,
+                    )
                 if sel is not None:
                     # Wait-n-f on the Gram: select on the (q, q) sub-Gram,
                     # scatter the weights back — per-leaf row gathers never
@@ -430,6 +526,12 @@ def make_trainer(
             poisoned = apply_gradient_attack(
                 attack, flat_raw, byz_mask, key=atk_key, **attack_params
             )
+            if stale_w is not None:
+                # The tap audits the rule's selection over the SAME rows
+                # the rule consumed — staleness-weighted included.
+                poisoned = (poisoned * stale_w[:, None]).astype(
+                    poisoned.dtype
+                )
             tap_center = (
                 ravel_pytree(state.gar_state)[0]
                 if gar.stateful_center else None

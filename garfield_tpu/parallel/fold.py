@@ -112,7 +112,8 @@ def _sanitize_gram(gram_p, row_scale):
 
 
 def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
-                          gar_params=None, subset_sel=None):
+                          gar_params=None, subset_sel=None,
+                          row_weights=None):
     """Aggregate a stacked gradient TREE under a folded attack plan.
 
     Args:
@@ -129,6 +130,18 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
         ``gram_select`` rules only, where subsetting is a (q, q) gather of
         the remapped Gram plus a weight scatter — no per-leaf row gathers,
         so the async emulation keeps the fast path (VERDICT r4 #5).
+      row_weights: optional (n,) per-row scalars (may be traced) COMPOSED
+        with the fold — the bounded-staleness discount
+        (``utils.rounds.staleness_weights``, DESIGN.md §14). A weighted
+        poisoned row is ``(w_i * row_scale_i) * ext[row_map[i]]``, i.e.
+        exactly the fold's own row-scale algebra, so the weights multiply
+        into the remapped Gram (outer product) and the selection weights
+        without the rows ever materializing — ``plan_for`` still applies.
+        Supported for ``gram_select`` rules only (the other fold forms
+        consume row VALUES; topologies route weighted aggregation there
+        through the flat path). Weights must be strictly positive (the
+        hard cutoff excludes rows BEFORE the fold; a traced zero weight
+        would defeat the static crash-row sanitization).
 
     Returns the aggregated gradient tree (no leading axis) — identical in
     exact arithmetic to ``gar.tree_aggregate(where-poisoned tree)``.
@@ -153,6 +166,13 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
             "coordinate-wise / iterative folds need row values, where a "
             "dynamic subset would force per-leaf gathers — topologies "
             "route those to the flat path instead)"
+        )
+    if row_weights is not None and gar.gram_select is None:
+        raise ValueError(
+            "row_weights (the staleness discount) composes with "
+            "gram_select rules only — other fold forms consume row "
+            "values; topologies route weighted aggregation there through "
+            "the flat path"
         )
     params = dict(gar_params or {})
     # Carried center (stateful rules, cclip): arrives as a params-shaped
@@ -181,6 +201,12 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
             )
         rmap = plan.row_map
         scale = jnp.asarray(plan.row_scale)
+        if row_weights is not None:
+            # Staleness composition (DESIGN.md §14): per-row weights are
+            # row scales, so they fold into the SAME algebra the attack
+            # plan uses — the Gram remap below and the weighted sum both
+            # see the composed scale and nothing row-shaped materializes.
+            scale = scale * jnp.asarray(row_weights, scale.dtype)
         scale_outer = scale[:, None] * scale[None, :]
         gram = tree_gram(ext)  # (n+k, n+k), fuses into the backward like f=0
         gram_p = sanitize_gram(gram[rmap][:, rmap] * scale_outer)

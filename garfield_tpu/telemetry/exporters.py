@@ -38,9 +38,15 @@ SCHEMA = "garfield-telemetry"
 # 10): the ``hier_bench`` kind (hierarchical bucketed-GAR sweep cells —
 # HIERBENCH_r*'s format, with peak-RSS accounting), ``gar_bench`` rows may
 # carry ``peak_rss_bytes``, and bench error records may carry
-# ``backend_outage`` (the BENCH_r05/MULTICHIP_r05 filter). v1/v2 records
-# still validate — consumers key on field presence, not version.
-SCHEMA_VERSION = 3
+# ``backend_outage`` (the BENCH_r05/MULTICHIP_r05 filter). v4 (round 11,
+# the bounded-staleness async plane — DESIGN.md §14): the per-round
+# ``staleness`` EVENT (per-rank staleness + discount weights, validated
+# below), ``summary.staleness`` digest (count/mean/max/hist), and
+# ``exchange_bench`` rows may carry ``peak_rss_bytes`` plus the
+# straggler-scenario fields (``straggler_ms``, ``sync_round_s``,
+# ``async_round_s``, ``speedup``). Older records still validate —
+# consumers key on field presence, not version.
+SCHEMA_VERSION = 4
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
          "transfer_bench", "exchange_bench", "hier_bench")
@@ -141,6 +147,20 @@ def validate_record(rec):
     elif kind == "event":
         if not isinstance(rec.get("event"), str):
             _fail(f"event.event must be a string, got {rec.get('event')!r}")
+        if rec.get("event") == "staleness":
+            # v4: the async quorum audit — parallel per-rank lists.
+            ranks = rec.get("ranks")
+            _check_float_list("staleness", "ranks", ranks)
+            for key in ("staleness", "weights"):
+                _check_float_list(
+                    "staleness", key, rec.get(key), len(ranks)
+                )
+            step = rec.get("step")
+            if not isinstance(step, int) or isinstance(step, bool) or step < 0:
+                _fail(
+                    f"staleness.step must be a non-negative int, "
+                    f"got {step!r}"
+                )
     elif kind == "summary":
         for key in ("steps", "events"):
             val = rec.get(key)
@@ -161,6 +181,25 @@ def validate_record(rec):
                         f"summary.step_time.{key} must be a number, "
                         f"got {val!r}"
                     )
+        sd = rec.get("staleness")
+        if sd is not None:
+            # v4: the async plane's digest (hub.staleness_stats).
+            if not isinstance(sd, dict):
+                _fail(f"summary.staleness must be an object, got {sd!r}")
+            for key in ("count", "mean", "max"):
+                if not _is_num(sd.get(key)):
+                    _fail(
+                        f"summary.staleness.{key} must be a number, "
+                        f"got {sd.get(key)!r}"
+                    )
+            hist = sd.get("hist")
+            if not isinstance(hist, dict) or not all(
+                _is_num(v) for v in hist.values()
+            ):
+                _fail(
+                    f"summary.staleness.hist must map staleness to "
+                    f"counts, got {hist!r}"
+                )
     elif kind == "bench":
         if not isinstance(rec.get("metric"), str):
             _fail(f"bench.metric must be a string, got {rec.get('metric')!r}")
@@ -224,13 +263,22 @@ def validate_record(rec):
                 f"exchange_bench.wire must be a string, got "
                 f"{rec.get('wire')!r}"
             )
-        for key in ("round_s", "wire_bytes_per_step"):
+        for key in ("round_s", "wire_bytes_per_step", "straggler_ms",
+                    "sync_round_s", "async_round_s", "speedup"):
             val = rec.get(key)
             if val is not None and not _is_num(val):
                 _fail(
                     f"exchange_bench.{key} must be a number or null, "
                     f"got {val!r}"
                 )
+        rss = rec.get("peak_rss_bytes")
+        if rss is not None and (
+            not isinstance(rss, int) or isinstance(rss, bool) or rss < 0
+        ):
+            _fail(
+                f"exchange_bench.peak_rss_bytes must be a non-negative "
+                f"int or null, got {rss!r}"
+            )
     # kind == "run": meta payload is free-form (validated as JSON above).
     return rec
 
@@ -314,6 +362,37 @@ def prometheus_text(hub):
                "Publisher-side frames shed to sender-queue overflow "
                "(backpressure; the send-side twin of plane_drop).",
                [({}, float(w["send_queue_drops"]))])
+    stale = hub.staleness_stats()
+    if stale is not None:
+        # v4: bounded-staleness async plane (DESIGN.md §14) — a real
+        # Prometheus histogram over per-quorum-member staleness in
+        # rounds, plus the hard-cutoff tail visible in the +Inf bucket.
+        buckets = [0, 1, 2, 4, 8, 16, 32]
+        lines.append(
+            "# HELP garfield_staleness_rounds Staleness (rounds behind "
+            "the PS) of every async-quorum member."
+        )
+        lines.append("# TYPE garfield_staleness_rounds histogram")
+        cum = 0
+        for le in buckets:
+            cum = sum(
+                c for t, c in stale["hist"].items() if t <= le
+            )
+            lines.append(
+                f'garfield_staleness_rounds_bucket{{le="{le}"}} {cum}'
+            )
+        lines.append(
+            f'garfield_staleness_rounds_bucket{{le="+Inf"}} '
+            f'{stale["count"]}'
+        )
+        lines.append(
+            f'garfield_staleness_rounds_sum '
+            f'{stale["mean"] * stale["count"]:g}'
+        )
+        lines.append(f'garfield_staleness_rounds_count {stale["count"]}')
+        metric("garfield_staleness_rounds_max", "gauge",
+               "Largest staleness admitted so far (bounded by "
+               "--max_staleness).", [({}, float(stale["max"]))])
     susp = hub.suspicion()
     if susp is not None:
         metric("garfield_rank_suspicion", "gauge",

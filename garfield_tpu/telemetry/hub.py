@@ -62,6 +62,17 @@ class MetricsHub:
             "bytes_out": 0, "bytes_in": 0, "frames_in": 0,
             "encode_s": 0.0, "decode_s": 0.0, "send_queue_drops": 0,
         }
+        # Bounded-staleness accounting (schema v4, DESIGN.md §14): the
+        # async PS emits one "staleness" event per round with the
+        # quorum's per-rank staleness + discount weights; folded into a
+        # rounds histogram (garfield_staleness_rounds) and — alongside
+        # the exclusion taps — into the per-rank suspicion score (a rank
+        # whose influence the discount keeps refusing is suspect the
+        # same way a rank the rule keeps excluding is).
+        self._staleness = {
+            "count": 0, "sum": 0, "max": 0,
+            "hist": collections.Counter(),
+        }
 
     # --- feeding -----------------------------------------------------------
 
@@ -135,6 +146,30 @@ class MetricsHub:
                     self._wire[key] += float(fields.get(key, 0.0) or 0.0)
             elif kind == "send_queue_drop":
                 self._wire["send_queue_drops"] += 1
+            elif kind == "staleness":
+                # Per-round async-quorum audit (apps/cluster.py): fold
+                # the discount deficit (1 - w) into the same exclusion-
+                # frequency suspicion the taps feed — each quorum rank
+                # was observed once and had (1 - w) of its influence
+                # refused by the staleness discount.
+                ranks = np.asarray(fields.get("ranks", ()), np.int64)
+                taus = np.asarray(fields.get("staleness", ()), np.int64)
+                ws = np.asarray(fields.get("weights", ()), np.float64)
+                if ranks.size and taus.size == ranks.size:
+                    st = self._staleness
+                    st["count"] += int(ranks.size)
+                    st["sum"] += int(taus.sum())
+                    st["max"] = max(st["max"], int(taus.max()))
+                    for t in taus.tolist():
+                        st["hist"][int(t)] += 1
+                    if self.num_ranks and ranks.max() < self.num_ranks:
+                        self._ensure_ranks(self.num_ranks)
+                        if ws.size == ranks.size:
+                            np.add.at(self._observed, ranks, 1.0)
+                            np.add.at(
+                                self._excluded, ranks,
+                                np.clip(1.0 - ws, 0.0, 1.0),
+                            )
             elif kind == "hier_exclusion":
                 # The hierarchical reducer's per-client audit (aggregators/
                 # hierarchy.py): observed/selected weight vectors over the
@@ -194,6 +229,24 @@ class MetricsHub:
         with self._lock:
             return dict(self._wire)
 
+    def staleness_stats(self):
+        """count/mean/max + rounds histogram over every quorum member of
+        every async round, or None when no staleness event was folded
+        (synchronous runs). The histogram keys are staleness-in-rounds —
+        the ``garfield_staleness_rounds`` exposition."""
+        with self._lock:
+            st = self._staleness
+            if not st["count"]:
+                return None
+            return {
+                "count": int(st["count"]),
+                "mean": float(st["sum"] / st["count"]),
+                "max": int(st["max"]),
+                "hist": {int(k): int(v) for k, v in sorted(
+                    st["hist"].items()
+                )},
+            }
+
     def step_time_stats(self):
         """count/mean/min/max plus p50/p95/p99 over the recorded step
         times (the chunking win — fewer, fatter dispatches — shows up in
@@ -215,6 +268,7 @@ class MetricsHub:
     def summary(self):
         """The run-closing JSONL record: suspicion, counters, timings."""
         susp = self.suspicion()
+        stale = self.staleness_stats()
         with self._lock:
             return make_record(
                 "summary",
@@ -255,6 +309,9 @@ class MetricsHub:
                     else {k: (round(v, 6) if isinstance(v, float) else v)
                           for k, v in self._wire.items()}
                 ),
+                # schema v4: the async plane's staleness digest (None on
+                # synchronous runs — v3 consumers are unaffected).
+                staleness=stale,
                 meta=self.meta,
             )
 

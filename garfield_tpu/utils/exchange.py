@@ -42,7 +42,7 @@ import time
 
 from ..native import MultiBuffer
 
-__all__ = ["PeerExchange"]
+__all__ = ["PeerExchange", "RoundCollector"]
 
 _HDR = struct.Struct("!IQQ")
 _SLOT = struct.Struct("!Q")
@@ -296,7 +296,7 @@ class PeerExchange:
     # --- collect (wait-n-f) ------------------------------------------------
 
     def _wait_slot(self, idx, step, deadline_box, results, sem,
-                   transform=None):
+                   transform=None, cancel=None):
         """Block on the native register until peer idx publishes ``step``.
 
         Only the EXACT step joins the quorum: the register is
@@ -306,8 +306,15 @@ class PeerExchange:
         the aggregation. ``deadline_box[0]`` is None until the caller's
         ``wait()`` arms it (collect_begin semantics: frames latch from
         registration, the timeout clock starts at harvest); reads run in
-        1 s chunks while unarmed so arming takes effect promptly.
-        Intermediate older frames do not restart the deadline.
+        1 s chunks (armed or not) so arming — and ``cancel`` — take
+        effect promptly. Intermediate older frames do not restart the
+        deadline.
+
+        ``cancel`` is the registration's lifecycle event: a role shutting
+        down (or changing membership) mid-registration sets it and the
+        waiter exits within one read chunk instead of lingering until the
+        deadline or ``close()`` — the thread-leak fix pinned by
+        tests/test_exchange.py.
 
         ``transform`` runs HERE, in the waiter thread, the moment the
         frame lands — this is the eager-decode hook the cluster driver
@@ -320,7 +327,9 @@ class PeerExchange:
         """
         version = 0
         try:
-            while not self._closing.is_set():
+            while not self._closing.is_set() and not (
+                cancel is not None and cancel.is_set()
+            ):
                 deadline = deadline_box[0]
                 if deadline is None:
                     chunk_ms = 1_000
@@ -331,7 +340,7 @@ class PeerExchange:
                 try:
                     version, raw = self._mb.read(
                         idx, min_version=version + 1,
-                        timeout_ms=max(chunk_ms, 1),
+                        timeout_ms=min(max(chunk_ms, 1), 1_000),
                     )
                 except TimeoutError:
                     continue  # chunk expired: re-check deadline/closing
@@ -369,6 +378,13 @@ class PeerExchange:
         at ``wait()`` — NOT here — so arbitrarily long local work (a first
         eval's compile) between registration and harvest cannot eat the
         quorum budget.
+
+        The returned harvest exposes ``wait.cancel()``: a registration a
+        role will never harvest (shutdown, membership change, a round
+        abandoned by a catch-up jump) MUST be cancelled so its waiter
+        threads exit within one read chunk instead of lingering until
+        ``close()`` — harvesting also auto-cancels whatever waiters are
+        still pending once it returns (tests/test_exchange.py pins both).
         """
         if step >= _CLOSE_STEP:
             raise ValueError(f"step {step} reserved for the close sentinel")
@@ -378,13 +394,15 @@ class PeerExchange:
         results = {}
         sem = threading.Semaphore(0)
         deadline_box = [None]  # armed by wait()
+        cancel = threading.Event()
         # Prune finished waiters from earlier collects — without this a long
         # run retains O(steps * n) dead Thread objects until close().
         self._waiters = [t for t in self._waiters if t.is_alive()]
         for idx in peers:
             t = threading.Thread(
                 target=self._wait_slot,
-                args=(idx, step, deadline_box, results, sem, transform),
+                args=(idx, step, deadline_box, results, sem, transform,
+                      cancel),
                 daemon=True,
             )
             self._waiters.append(t)
@@ -399,23 +417,35 @@ class PeerExchange:
             t0 = time.monotonic()
             deadline_box[0] = t0 + timeout_ms / 1000.0
             hard = deadline_box[0] + 2.0
-            for _ in range(len(peers)):
-                if not sem.acquire(timeout=max(hard - time.monotonic(), 0.1)):
-                    break
+            try:
+                for _ in range(len(peers)):
+                    if not sem.acquire(
+                        timeout=max(hard - time.monotonic(), 0.1)
+                    ):
+                        break
+                    if len(results) >= q:
+                        _emit_wait(
+                            step, q, len(results), time.monotonic() - t0
+                        )
+                        return dict(results)
                 if len(results) >= q:
                     _emit_wait(step, q, len(results), time.monotonic() - t0)
                     return dict(results)
-            if len(results) >= q:
-                _emit_wait(step, q, len(results), time.monotonic() - t0)
-                return dict(results)
-            _emit_wait(
-                step, q, len(results), time.monotonic() - t0, timed_out=True
-            )
-            raise TimeoutError(
-                f"only {len(results)}/{q} peers reached step {step} "
-                f"within {timeout_ms} ms"
-            )
+                _emit_wait(
+                    step, q, len(results), time.monotonic() - t0,
+                    timed_out=True,
+                )
+                raise TimeoutError(
+                    f"only {len(results)}/{q} peers reached step {step} "
+                    f"within {timeout_ms} ms"
+                )
+            finally:
+                # Single-harvest contract: whatever waiters are still
+                # blocked (beyond-quorum slots, give-ups in flight) are
+                # released now instead of at their deadline.
+                cancel.set()
 
+        wait.cancel = cancel.set
         return wait
 
     def collect(self, step, q, *, timeout_ms=30_000, peers=None,
@@ -453,7 +483,10 @@ class PeerExchange:
         through several PS rounds harvests the newest model, exactly like
         a fresh ``read_latest`` would. Transform failures are stored as
         the payload (see ``_wait_slot``); the harvest's timeout clock
-        starts at ``wait()``, not here.
+        starts at ``wait()``, not here. A harvest that times out retires
+        the watcher (re-register to keep waiting), and ``wait.cancel()``
+        retires it WITHOUT harvesting — the role-shutdown lifecycle
+        contract shared with ``collect_begin``.
         """
         state = {"best": None}
         cond = threading.Condition()
@@ -504,7 +537,13 @@ class PeerExchange:
                 )
             return best
 
+        wait.cancel = harvested.set
         return wait
+
+    def round_collector(self, peers, *, transform=None):
+        """A ``RoundCollector`` over this exchange's ``peers`` slots — the
+        bounded-staleness quorum primitive (see the class docstring)."""
+        return RoundCollector(self, peers, transform=transform)
 
     def read_latest(self, idx, min_step, *, timeout_ms=30_000):
         """Newest (step, payload) in peer ``idx``'s slot with step >=
@@ -593,6 +632,172 @@ class PeerExchange:
             t.join(timeout=5)
         self._accept_thread.join(timeout=5)
         self._mb.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RoundCollector:
+    """Round-tagged register view: pre-registered MULTI-round watchers.
+
+    The bounded-staleness quorum primitive (DESIGN.md §14). One
+    PERSISTENT watcher thread per peer latches EVERY frame version the
+    native register delivers — round tag, payload (through the eager
+    ``transform`` decode hook, like ``collect_begin``'s waiters), and a
+    global arrival generation — into a host-side view that outlives any
+    single round. ``gather(round, q, max_staleness=s)`` then blocks until
+
+      1. at least ``q`` peers hold an ADMISSIBLE frame (tag within ``s``
+         rounds of ``round`` — stale frames are REUSED across gathers
+         instead of re-collected, which is what lets the consumer's round
+         rate decouple from the slowest publisher), and
+      2. at least one admissible frame is NEW since the previous harvest
+         (``require_fresh``): without this floor the consumer could
+         free-run on the same cached frames, re-applying identical data
+         at host speed — bounded staleness throttles it to the fastest
+         publisher's pace instead.
+
+    Compared to per-round ``collect_begin`` registrations this also fixes
+    the watcher lifecycle: no per-round thread churn, membership changes
+    (``remove_peer`` on a ban or a leave, ``add_peer`` on a join) retire
+    or start exactly one thread, and ``close()`` cancels everything
+    deterministically. The watcher threads are registered in the owning
+    exchange's waiter list so ``PeerExchange.close()`` joins them before
+    freeing the native register (the use-after-free contract in
+    ``close``'s docstring).
+
+    At ``max_staleness=0`` a gather admits exact-round frames only — the
+    synchronous wait-n-f contract — which is the host-plane half of the
+    ``--max_staleness 0`` bitwise-equality guarantee.
+    """
+
+    def __init__(self, exchange, peers, *, transform=None):
+        self._ex = exchange
+        self._transform = transform
+        self._cond = threading.Condition()
+        self._frames = {}   # peer -> (step, payload, generation)
+        self._gen = 0       # global arrival counter
+        self._mark = 0      # newest generation consumed by a harvest
+        self._threads = {}
+        self._stops = {}
+        for idx in peers:
+            self.add_peer(idx)
+
+    def peers(self):
+        with self._cond:
+            return sorted(self._threads)
+
+    def add_peer(self, idx):
+        """Start (or restart) the watcher for peer ``idx`` — a JOIN in a
+        churn scenario. Idempotent for already-watched peers."""
+        idx = int(idx)
+        with self._cond:
+            if idx in self._threads and self._threads[idx].is_alive():
+                return
+            stop = threading.Event()
+            t = threading.Thread(
+                target=self._watch, args=(idx, stop), daemon=True
+            )
+            self._stops[idx] = stop
+            self._threads[idx] = t
+        # Same join-before-register-free contract as collect_begin waiters.
+        self._ex._waiters = [
+            w for w in self._ex._waiters if w.is_alive()
+        ]
+        self._ex._waiters.append(t)
+        t.start()
+
+    def remove_peer(self, idx):
+        """Cancel peer ``idx``'s watcher and drop its cached frame — a
+        LEAVE (or a Byzantine ban). The thread exits within one read
+        chunk; joined here so membership changes never leak threads."""
+        idx = int(idx)
+        with self._cond:
+            stop = self._stops.pop(idx, None)
+            t = self._threads.pop(idx, None)
+            self._frames.pop(idx, None)
+            if stop is not None:
+                # Under the lock: a watcher mid-decode re-checks this
+                # before writing, so a removed peer's frame cannot be
+                # resurrected by an in-flight arrival.
+                stop.set()
+        if t is not None:
+            t.join(timeout=5)
+
+    def _watch(self, idx, stop):
+        version = 0
+        ex = self._ex
+        while not (stop.is_set() or ex._closing.is_set()):
+            try:
+                version, raw = ex._mb.read(
+                    idx, min_version=version + 1, timeout_ms=200
+                )
+            except TimeoutError:
+                continue
+            (got_step,) = _SLOT.unpack_from(raw)
+            if got_step == _CLOSE_STEP:
+                break
+            payload = raw[_SLOT.size:]
+            if self._transform is not None:
+                try:
+                    payload = self._transform(idx, payload)
+                except Exception as exc:  # noqa: BLE001 — ban evidence
+                    payload = exc
+            with self._cond:
+                if stop.is_set():
+                    break  # removed while decoding: drop, don't resurrect
+                self._gen += 1
+                self._frames[idx] = (got_step, payload, self._gen)
+                self._cond.notify_all()
+
+    def gather(self, round_, q, *, max_staleness=0, timeout_ms=30_000,
+               require_fresh=True):
+        """Admissible frames for ``round_``: ``{peer: (tag, payload)}``.
+
+        Blocks until >= ``q`` peers hold a frame tagged within
+        ``max_staleness`` rounds of ``round_`` and (``require_fresh``) at
+        least one of them arrived since the previous harvest; returns ALL
+        admissible frames (the caller picks the freshest ``q`` — ties
+        break on rank for deterministic composition). Payloads may be
+        stored transform exceptions — Byzantine ban evidence the caller
+        must attribute, exactly like ``collect``'s contract. Raises
+        TimeoutError with the admissible count otherwise.
+        """
+        t0 = time.monotonic()
+        deadline = t0 + timeout_ms / 1000.0
+        lo = round_ - max_staleness
+        with self._cond:
+            while True:
+                adm = {
+                    p: f for p, f in self._frames.items() if f[0] >= lo
+                }
+                if len(adm) >= q:
+                    newest = max(g for _, _, g in adm.values())
+                    if not require_fresh or newest > self._mark:
+                        self._mark = max(self._mark, newest)
+                        _emit_wait(
+                            round_, q, len(adm), time.monotonic() - t0
+                        )
+                        return {p: (s, pl) for p, (s, pl, _) in adm.items()}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._ex._closing.is_set():
+                    _emit_wait(
+                        round_, q, len(adm), time.monotonic() - t0,
+                        timed_out=True,
+                    )
+                    raise TimeoutError(
+                        f"only {len(adm)}/{q} peers within staleness "
+                        f"{max_staleness} of round {round_} after "
+                        f"{timeout_ms} ms"
+                    )
+                self._cond.wait(timeout=min(remaining, 1.0))
+
+    def close(self):
+        for idx in list(self.peers()):
+            self.remove_peer(idx)
 
     def __enter__(self):
         return self

@@ -1,0 +1,126 @@
+"""Unified round/staleness policy: ONE weighting law for both planes.
+
+Bounded-staleness asynchronous aggregation (DESIGN.md §14) decouples the
+PS round rate from the slowest rank: the server applies the robust
+aggregate over the freshest ``q = n - f`` arrivals, each carrying a round
+tag, with staleness-discounted weights — Kardam's dampening (Damaskinos
+et al., 2018) composed with any registered GAR. The weighting law lives
+HERE, in one module both deployment scales import verbatim:
+
+  - the **host plane** (``apps/cluster.py`` roles over ``PeerExchange``):
+    real round tags from the wire, ``staleness_weights`` on the host,
+    rows scaled before the jit'd GAR call;
+  - the **in-graph SPMD plane** (``parallel/aggregathor.make_trainer``'s
+    ``staleness=`` emulation, the async analog of the seeded wait-n-f
+    ``subset``): the same function traced into the step program, weights
+    composed with the folded-attack row scales so ``fold.plan_for``'s
+    fast path still applies (parallel/fold.py ``row_weights``).
+
+A topology's staleness policy is therefore written once and deploys at
+either scale — the refactor target ROADMAP item 3 names.
+
+The law: ``w(tau) = decay ** tau`` for ``0 <= tau <= max_staleness``,
+``0`` past the hard cutoff, and **exactly 1.0 at tau = 0** (IEEE pow is
+exact there), so a fully-fresh quorum is bitwise-indistinguishable from
+the synchronous path — the ``--max_staleness 0`` equality contract
+(tests/test_staleness.py).
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+
+__all__ = [
+    "DEFAULT_MAX_STALENESS",
+    "DEFAULT_DECAY",
+    "StalenessPolicy",
+    "staleness_weights",
+    "discount_rows",
+    "resolve",
+]
+
+DEFAULT_MAX_STALENESS = 4
+DEFAULT_DECAY = 0.5
+
+
+def staleness_weights(tau, *, decay=DEFAULT_DECAY,
+                      max_staleness=DEFAULT_MAX_STALENESS):
+    """Per-row weights ``decay ** tau`` with a hard cutoff.
+
+    ``tau`` is the per-row staleness in rounds (current round minus the
+    row's round tag; negative values clamp to 0 — a frame can only be
+    tagged ahead of the consumer transiently, during catch-up races).
+    Accepts a numpy array (host plane) or a jnp array/tracer (in-graph
+    emulation) and computes with the matching backend, so the SAME
+    function serves both scales. Returns float32 weights; ``tau == 0``
+    maps to exactly 1.0 and ``tau > max_staleness`` to exactly 0.0.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    on_device = isinstance(tau, jax.Array)
+    xp = jnp if on_device else np
+    tau = xp.maximum(xp.asarray(tau, xp.int32), 0)
+    w = xp.power(xp.float32(decay), tau.astype(xp.float32))
+    w = xp.where(tau > max_staleness, xp.float32(0.0), w)
+    return w.astype(xp.float32)
+
+
+def discount_rows(stack, w):
+    """Scale each row of an ``(n, d)`` stack (or any array with leading
+    row axis) by its staleness weight — the "weights composed before the
+    GAR" step on every path. At ``w == 1`` this is a bitwise no-op per
+    IEEE multiply; callers that need *program*-level identity (the
+    ``--max_staleness 0`` bitwise contract) short-circuit before calling.
+    """
+    return (stack * w.reshape((-1,) + (1,) * (stack.ndim - 1))).astype(
+        stack.dtype
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class StalenessPolicy:
+    """The deployment's bounded-staleness contract: hard cutoff + decay.
+
+    ``max_staleness`` bounds how many rounds behind the PS a gradient may
+    be and still enter the aggregate (0 = the synchronous contract:
+    exact-round frames only, all weights 1); ``decay`` is the per-round
+    geometric discount.
+    """
+
+    max_staleness: int = DEFAULT_MAX_STALENESS
+    decay: float = DEFAULT_DECAY
+
+    def __post_init__(self):
+        if self.max_staleness < 0:
+            raise ValueError(
+                f"max_staleness must be >= 0, got {self.max_staleness}"
+            )
+        if not (0.0 < self.decay <= 1.0):
+            raise ValueError(f"decay must be in (0, 1], got {self.decay}")
+
+    def weights(self, tau):
+        return staleness_weights(
+            tau, decay=self.decay, max_staleness=self.max_staleness
+        )
+
+
+def resolve(args):
+    """``StalenessPolicy`` from the CLI flags, or None when ``--async``
+    is off. Flag defaults come from ``GARFIELD_MAX_STALENESS`` /
+    ``GARFIELD_STALENESS_DECAY`` so a deployment script can switch the
+    whole fleet without editing every role's command line."""
+    if not getattr(args, "async_agg", False):
+        return None
+    ms = getattr(args, "max_staleness", None)
+    if ms is None:
+        ms = int(os.environ.get(
+            "GARFIELD_MAX_STALENESS", DEFAULT_MAX_STALENESS
+        ))
+    decay = getattr(args, "staleness_decay", None)
+    if decay is None:
+        decay = float(os.environ.get(
+            "GARFIELD_STALENESS_DECAY", DEFAULT_DECAY
+        ))
+    return StalenessPolicy(max_staleness=int(ms), decay=float(decay))

@@ -69,9 +69,22 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 # timeout then costs the slowest, most redundant coverage (the app flows
 # are also exercised piecewise by the unit files), not the matrix.
 # test_hierarchy_stream.py is end-to-end too (slow-marked multi-wave
-# TCP-exchange ingest into the hierarchical reducer) and collects before
-# the app runs when slow tests are enabled.
-_RUN_LAST = {"test_hierarchy_stream.py": 1, "test_apps.py": 2}
+# TCP-exchange ingest into the hierarchical reducer), as are the
+# multi-PROCESS deployment suites (subprocess fleets over PeerExchange):
+# test_multihost_integration.py, test_cluster.py, test_async_cluster.py.
+# All collect before the app runs when slow tests are enabled.
+_RUN_LAST = {
+    "test_multihost_integration.py": 1,
+    "test_hierarchy_stream.py": 2,
+    "test_cluster.py": 3,
+    "test_async_cluster.py": 4,
+    "test_apps.py": 5,
+}
+
+# Tier-1 wall-clock budget of the verify command (ROADMAP.md): the
+# watchdog below warns when a run gets close, so a creeping suite is
+# visible BEFORE the external timeout starts starving the e2e tail.
+_TIER1_BUDGET_S = 870
 
 
 def pytest_collection_modifyitems(config, items):
@@ -89,11 +102,39 @@ def pytest_collection_modifyitems(config, items):
 
     pattern = re.compile(r"\bapp_\w+\.main\(")
     src_cache = {}
+    file_src_cache = {}
+    popen = re.compile(r"\bsubprocess\.Popen\b")
+    garfield = re.compile(r"garfield_tpu\.(apps|utils\.multihost)|"
+                          r"multihost_child")
     for it in items:
         fn = getattr(it, "function", None)
-        if fn is None or it.get_closest_marker("slow") is not None:
+        if fn is None:
             continue
-        if it.fspath.basename in _RUN_LAST:
+        # Multi-process e2e discipline: a FILE that spawns garfield
+        # subprocess fleets (subprocess.Popen + app/multihost plumbing)
+        # must be registered in _RUN_LAST — those files hold the most
+        # expensive, most redundant coverage and must collect last even
+        # in full-suite runs; a new one fails here at collection.
+        path = str(it.fspath)
+        if path not in file_src_cache:
+            try:
+                with open(path) as fp:
+                    src = fp.read()
+            except OSError:
+                src = ""
+            file_src_cache[path] = bool(
+                popen.search(src) and garfield.search(src)
+            )
+        assert not file_src_cache[path] or (
+            it.fspath.basename in _RUN_LAST
+        ), (
+            f"{it.fspath.basename} spawns garfield subprocess fleets "
+            "(multi-process e2e) but is not registered in "
+            "conftest._RUN_LAST — register it so the unit matrix keeps "
+            "collection priority"
+        )
+        if (it.get_closest_marker("slow") is not None
+                or it.fspath.basename in _RUN_LAST):
             continue
         if fn not in src_cache:
             try:
@@ -105,4 +146,31 @@ def pytest_collection_modifyitems(config, items):
             "tier-1 test outside conftest._RUN_LAST — move it to a "
             "registered end-to-end file (or slow-mark it) so the unit "
             "matrix keeps collection priority (tier-1 budget discipline)"
+        )
+
+
+def pytest_sessionstart(session):
+    import time
+
+    session._garfield_t0 = time.time()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # Tier-1 budget watchdog: the fast shard (-m 'not slow') must stay
+    # under the verify command's 870 s timeout on the 1-core box. Warn
+    # at 90% so growth is caught in review, not as a truncated CI run.
+    import sys
+    import time
+
+    markexpr = getattr(session.config.option, "markexpr", "") or ""
+    if "not slow" not in markexpr:
+        return
+    wall = time.time() - getattr(session, "_garfield_t0", time.time())
+    if wall > 0.9 * _TIER1_BUDGET_S:
+        print(
+            f"\n[tier-1 budget watchdog] fast shard took {wall:.0f}s — "
+            f"{'OVER' if wall > _TIER1_BUDGET_S else 'within 10% of'} "
+            f"the {_TIER1_BUDGET_S}s budget; trim or slow-mark the "
+            "newest fast tests (conftest._TIER1_BUDGET_S)",
+            file=sys.stderr,
         )

@@ -187,6 +187,175 @@ def test_late_joiner_catches_up():
             p.close()
 
 
+def test_collect_begin_cancel_retires_waiters():
+    """Watcher lifecycle (DESIGN.md §14 satellite): a registration a role
+    never harvests must cancel its waiter threads promptly — before this
+    fix they lingered until the deadline or close(), leaking one thread
+    per peer per abandoned round."""
+    import time
+
+    peers = _mesh(2)
+    try:
+        wait = peers[0].collect_begin(50, q=2, timeout_ms=600_000)
+        time.sleep(0.3)
+        assert sum(t.is_alive() for t in peers[0]._waiters) == 2
+        wait.cancel()
+        deadline = time.time() + 5
+        while (any(t.is_alive() for t in peers[0]._waiters)
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert not any(t.is_alive() for t in peers[0]._waiters)
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_harvest_auto_cancels_pending_waiters():
+    """A harvested registration releases its beyond-quorum waiters
+    immediately instead of at their deadline."""
+    import time
+
+    peers = _mesh(3)
+    try:
+        for p in peers[:2]:
+            p.publish(4, b"x")
+        wait = peers[0].collect_begin(4, q=2, timeout_ms=600_000)
+        got = wait()
+        assert set(got) == {0, 1}
+        deadline = time.time() + 5
+        while (any(t.is_alive() for t in peers[0]._waiters)
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert not any(t.is_alive() for t in peers[0]._waiters), (
+            "peer 2's waiter survived the harvest"
+        )
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_read_latest_begin_cancel_retires_watcher():
+    import time
+
+    peers = _mesh(2)
+    try:
+        wait = peers[0].read_latest_begin(1, 99)
+        time.sleep(0.2)
+        assert any(t.is_alive() for t in peers[0]._waiters)
+        wait.cancel()
+        deadline = time.time() + 5
+        while (any(t.is_alive() for t in peers[0]._waiters)
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert not any(t.is_alive() for t in peers[0]._waiters)
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_round_collector_stale_reuse_and_cutoff():
+    """The bounded-staleness quorum primitive (DESIGN.md §14): admissible
+    frames are reused across gathers within the cutoff; past it the
+    gather times out instead of mixing over-stale data in."""
+    peers = _mesh(3)
+    try:
+        col = peers[0].round_collector([1, 2])
+        peers[1].publish(5, b"p1r5", to=[0])
+        peers[2].publish(3, b"p2r3", to=[0])
+        got = col.gather(5, 2, max_staleness=2, timeout_ms=10_000)
+        assert got == {1: (5, b"p1r5"), 2: (3, b"p2r3")}
+        # Stale REUSE: round 6 re-admits peer 2's round-3 frame (tau=3)
+        # without a re-collect; peer 1's new frame is the fresh floor.
+        peers[1].publish(6, b"p1r6", to=[0])
+        got = col.gather(6, 2, max_staleness=3, timeout_ms=10_000)
+        assert got == {1: (6, b"p1r6"), 2: (3, b"p2r3")}
+        # Hard cutoff: at round 8 with max_staleness=2 the round-3 frame
+        # is inadmissible — 1/2 peers only.
+        peers[1].publish(8, b"p1r8", to=[0])
+        with pytest.raises(TimeoutError, match="1/2"):
+            col.gather(8, 2, max_staleness=2, timeout_ms=300)
+        col.close()
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_round_collector_freshness_membership_transform():
+    """One mesh (the close() tax dominates this file's runtime), three
+    contracts: the freshness floor (a gather must include >= 1 NEW
+    arrival — no free-running on cached frames), membership changes
+    (remove_peer retires the watcher + frame, add_peer restarts — the
+    churn leave/join path), and the transform-error ban-evidence storage
+    (same contract as collect())."""
+    import threading
+    import time
+
+    peers = _mesh(3)
+    try:
+        # --- freshness floor (collector over peer 1 only) -------------
+        col = peers[0].round_collector([1])
+        peers[1].publish(1, b"r1", to=[0])
+        assert col.gather(1, 1, max_staleness=4, timeout_ms=10_000) == {
+            1: (1, b"r1")
+        }
+        result = {}
+
+        def g():
+            result.update(col.gather(2, 1, max_staleness=4,
+                                     timeout_ms=15_000))
+
+        t = threading.Thread(target=g)
+        t.start()
+        time.sleep(0.4)
+        assert not result, "gather returned without a fresh arrival"
+        peers[1].publish(2, b"r2", to=[0])
+        t.join(timeout=10)
+        assert result == {1: (2, b"r2")}
+        # require_fresh=False reuses freely.
+        assert col.gather(3, 1, max_staleness=4, timeout_ms=10_000,
+                          require_fresh=False) == {1: (2, b"r2")}
+
+        # --- membership (second collector, peers 1+2) ------------------
+        col2 = peers[0].round_collector([1, 2])
+        peers[2].publish(2, b"b", to=[0])
+        col2.gather(2, 2, max_staleness=0, timeout_ms=10_000)
+        col2.remove_peer(2)
+        assert col2.peers() == [1]
+        peers[1].publish(3, b"a3", to=[0])
+        assert col2.gather(3, 1, max_staleness=0, timeout_ms=10_000) == {
+            1: (3, b"a3")
+        }
+        col2.add_peer(2)
+        peers[2].publish(3, b"b3", to=[0])
+        got = col2.gather(3, 2, max_staleness=0, timeout_ms=10_000,
+                          require_fresh=False)
+        assert got == {1: (3, b"a3"), 2: (3, b"b3")}
+
+        # --- transform error stored as ban evidence --------------------
+        def boom(idx, payload):
+            raise ValueError(f"bad frame from {idx}")
+
+        col3 = peers[0].round_collector([2], transform=boom)
+        peers[2].publish(4, b"x", to=[0])
+        tag, payload = col3.gather(
+            4, 1, max_staleness=0, timeout_ms=10_000
+        )[2]
+        assert tag == 4 and isinstance(payload, ValueError)
+
+        # --- close() retires every watcher -----------------------------
+        for c in (col, col2, col3):
+            c.close()
+            assert c.peers() == []
+        deadline = time.time() + 5
+        while (any(t.is_alive() for t in peers[0]._waiters)
+               and time.time() < deadline):
+            time.sleep(0.05)
+        assert not any(t.is_alive() for t in peers[0]._waiters)
+    finally:
+        for p in peers:
+            p.close()
+
+
 def test_collect_begin_latches_before_overwrite():
     """Pre-registered waiters (collect_begin) must latch a frame that is
     later overwritten — the publish-then-collect race a symmetric gossip
