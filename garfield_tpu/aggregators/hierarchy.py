@@ -69,6 +69,7 @@ import numpy as np
 from . import gars, register
 from ._common import as_stack, concat_stack, num_gradients, unflatten_vec
 from ..ops import coordinate as _coord
+from ..telemetry import trace as _trace
 from ..utils import tools
 
 __all__ = [
@@ -714,14 +715,20 @@ class StreamingAggregator:
             # refilled until after that. (Same aliasing gar_bench's
             # donation chain has to defend against; here it is the free
             # H2D we want.)
-            stack = jnp.asarray(buf[:used].reshape(take, size, -1))
-            fn = _wave_jit(level.rule, level.f, self._audit)
-            if self._audit:
-                out, w = fn(stack)
-                w = np.asarray(w)
-            else:
-                out = fn(stack)
-            out = np.asarray(out)  # blocks: summaries host-side, frees buf
+            # Trace span (schema v5): one per vmapped wave fold — the
+            # streaming reducer's unit of device work, so the report can
+            # attribute ingest wall clock to fold vs wire time.
+            with _trace.span("hier_wave", level=int(lvl_idx),
+                             buckets=int(take), size=int(size)):
+                stack = jnp.asarray(buf[:used].reshape(take, size, -1))
+                fn = _wave_jit(level.rule, level.f, self._audit)
+                if self._audit:
+                    out, w = fn(stack)
+                    w = np.asarray(w)
+                else:
+                    out = fn(stack)
+                # blocks: summaries host-side, frees buf
+                out = np.asarray(out)
             del stack
             # Shift the spill (the partially-filled next bucket) to the
             # buffer front; at most one bucket's worth, so the copy is
@@ -759,20 +766,21 @@ class StreamingAggregator:
                 raise ValueError(
                     f"only {self._arrived}/{self.n} clients ingested"
                 )
-            for lvl_idx in range(len(self._levels)):
-                self._drain(lvl_idx, flush=True)
-            stack = jnp.asarray(np.stack(self._final_rows))
-            fn = _final_jit(self.plan.final_rule, self.plan.final_f,
-                            self._audit)
-            if self._audit:
-                out, w_fin = fn(stack)
-                w_fin = np.asarray(w_fin)
-                for j, (a, b) in enumerate(self._final_spans):
-                    if w_fin[j] == 0:
-                        self._keep[a:b] = 0.0
-            else:
-                out = fn(stack)
-            self._result = np.asarray(out)
+            with _trace.span("hier_finalize", levels=len(self._levels)):
+                for lvl_idx in range(len(self._levels)):
+                    self._drain(lvl_idx, flush=True)
+                stack = jnp.asarray(np.stack(self._final_rows))
+                fn = _final_jit(self.plan.final_rule, self.plan.final_f,
+                                self._audit)
+                if self._audit:
+                    out, w_fin = fn(stack)
+                    w_fin = np.asarray(w_fin)
+                    for j, (a, b) in enumerate(self._final_spans):
+                        if w_fin[j] == 0:
+                            self._keep[a:b] = 0.0
+                else:
+                    out = fn(stack)
+                self._result = np.asarray(out)
             self._final_rows = []
             if self._telemetry:
                 from ..telemetry import hub as _hub

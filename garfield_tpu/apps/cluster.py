@@ -65,7 +65,7 @@ from jax.flatten_util import ravel_pytree
 
 from ..aggregators import gars
 from ..parallel import core
-from ..telemetry import hub as tele_hooks
+from ..telemetry import hub as tele_hooks, trace as tele_trace
 from ..utils import multihost, rounds, tools, wire
 from ..utils.exchange import PeerExchange
 from . import common
@@ -172,7 +172,12 @@ def _telemetry_open(args, who, num_ranks=None, meta=None):
     its own file — roles are separate OS processes), installed as the
     process-global sink so exchange wait latencies and the liveness
     events below land in the stream. Returns (hub, exporter) or
-    (None, None) when --telemetry is off."""
+    (None, None) when --telemetry is off. With --trace/GARFIELD_TRACE
+    the round-tracing spans (telemetry/trace.py, schema v5) are enabled
+    into the same per-role stream — the raw material of
+    ``python -m garfield_tpu.telemetry.report``."""
+    if tele_trace.requested(args) and not getattr(args, "telemetry", None):
+        args.telemetry = "telemetry"  # spans need the JSONL sink
     if not getattr(args, "telemetry", None):
         return None, None
     import os
@@ -190,12 +195,15 @@ def _telemetry_open(args, who, num_ranks=None, meta=None):
     )
     exp.write(tele_fmt.make_record("run", meta=hub.meta))
     tele_hooks.install(hub)
+    if tele_trace.requested(args):
+        tele_trace.enable(who=who)
     return hub, exp
 
 
 def _telemetry_close(hub, exp):
     if hub is None:
         return
+    tele_trace.disable()
     try:
         exp.write(hub.summary())
     finally:
@@ -790,33 +798,36 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
             )
         for i in range(start_iter, args.num_iter):
             t_step = time.time()
-            frame = _encode_frame(
-                [flat] + ([bn_mean] if bn_elems else []),
-                wire_stats, fanout=len(worker_ranks),
-            )
-            ex.publish(i, frame, to=worker_ranks)
+            with tele_trace.span("broadcast", step=i):
+                frame = _encode_frame(
+                    [flat] + ([bn_mean] if bn_elems else []),
+                    wire_stats, fanout=len(worker_ranks),
+                )
+                ex.publish(i, frame, to=worker_ranks)
             w = None
             if collector is not None:
                 # Bounded staleness (DESIGN.md §14): admissible frames —
                 # freshest per worker, reused across rounds within the
                 # cutoff — instead of an exact-round quorum; the freshest
                 # q compose the aggregate with decayed weights.
-                got = _async_gradient_quorum(
-                    collector, i, q, policy,
-                    lambda: ex.publish(i, frame, to=worker_ranks),
-                    timeout_ms, "cluster-ps",
-                )
+                with tele_trace.span("quorum", step=i):
+                    got = _async_gradient_quorum(
+                        collector, i, q, policy,
+                        lambda: ex.publish(i, frame, to=worker_ranks),
+                        timeout_ms, "cluster-ps",
+                    )
                 quorum, taus, w = _staleness_quorum(
                     got, i, q, policy, worker_ranks, "cluster-ps"
                 )
                 rows = {k: got[k][1] for k in quorum}
             else:
-                got, good_ranks = _gradient_quorum(
-                    ex, i, q, good_ranks, split,
-                    lambda: ex.publish(i, frame, to=worker_ranks),
-                    timeout_ms, "cluster-ps", stats=wire_stats,
-                    wait_fn=grad_wait,
-                )
+                with tele_trace.span("quorum", step=i):
+                    got, good_ranks = _gradient_quorum(
+                        ex, i, q, good_ranks, split,
+                        lambda: ex.publish(i, frame, to=worker_ranks),
+                        timeout_ms, "cluster-ps", stats=wire_stats,
+                        wait_fn=grad_wait,
+                    )
                 # Overlap (DESIGN.md §11): the NEXT round's collect is
                 # registered before this round's device update/eval, so
                 # fast workers' next-round gradients are latched +
@@ -834,57 +845,64 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
                 # from the waiter threads.
                 quorum = sorted(got)[:q]
                 rows = {k: got[k] for k in quorum}
-            stack = jnp.stack([rows[k][0] for k in quorum])
-            if bn_elems:
-                # Robust coordinate-wise aggregation of the quorum's
-                # BatchNorm stats (trim f per side; plain mean at f=0 ==
-                # the on-mesh core.mean_model_state) — see _robust_stats.
-                # Async mode reuses the same quorum rows (stats staleness
-                # rides the same cutoff; the trim bounds a stale row like
-                # any other outlier).
-                bn_mean = _robust_stats(
-                    np.stack([rows[k][1] for k in quorum]), f
-                )
-            if w is not None and not np.all(w == 1.0):
-                stack_gar = stack * jnp.asarray(w)[:, None]
-                flat_dev, opt_state = ps_update_weighted(
-                    flat_dev, opt_state, stack, jnp.asarray(w),
-                    jnp.asarray(i, jnp.int32),
-                )
-            else:
-                # Fully-fresh quorum (or synchronous mode): the
-                # unweighted program — at --max_staleness 0 this is the
-                # bitwise synchronous trajectory.
-                stack_gar = stack
-                flat_dev, opt_state = ps_update(
-                    flat_dev, opt_state, stack,
-                    jnp.asarray(i, jnp.int32),
-                )
-            flat = np.asarray(flat_dev, np.float32)  # next publication
+            with tele_trace.span("gar_apply", step=i):
+                stack = jnp.stack([rows[k][0] for k in quorum])
+                if bn_elems:
+                    # Robust coordinate-wise aggregation of the quorum's
+                    # BatchNorm stats (trim f per side; plain mean at
+                    # f=0 == the on-mesh core.mean_model_state) — see
+                    # _robust_stats. Async mode reuses the same quorum
+                    # rows (stats staleness rides the same cutoff; the
+                    # trim bounds a stale row like any other outlier).
+                    with tele_trace.span("bn_stats", step=i):
+                        bn_mean = _robust_stats(
+                            np.stack([rows[k][1] for k in quorum]), f
+                        )
+                if w is not None and not np.all(w == 1.0):
+                    stack_gar = stack * jnp.asarray(w)[:, None]
+                    flat_dev, opt_state = ps_update_weighted(
+                        flat_dev, opt_state, stack, jnp.asarray(w),
+                        jnp.asarray(i, jnp.int32),
+                    )
+                else:
+                    # Fully-fresh quorum (or synchronous mode): the
+                    # unweighted program — at --max_staleness 0 this is
+                    # the bitwise synchronous trajectory.
+                    stack_gar = stack
+                    flat_dev, opt_state = ps_update(
+                        flat_dev, opt_state, stack,
+                        jnp.asarray(i, jnp.int32),
+                    )
+                flat = np.asarray(flat_dev, np.float32)  # next publication
             wire_stats.flush(i)
             if tele_hub is not None:
                 # Worker index = exchange rank - first worker rank; the q
                 # quorum members are the observed ranks this step. The
                 # tap audits the rows the rule consumed — staleness-
-                # weighted included.
-                sel = jnp.asarray(
-                    [k - worker_ranks[0] for k in quorum], jnp.int32
-                )
-                tele_hub.record_step(
-                    i, tap=tap_fn(stack_gar, sel),
-                    step_time_s=time.time() - t_step,
-                )
+                # weighted included. Its own span: the audit pass is
+                # telemetry cost, not round cost, and the report should
+                # say so.
+                with tele_trace.span("audit", step=i):
+                    sel = jnp.asarray(
+                        [k - worker_ranks[0] for k in quorum], jnp.int32
+                    )
+                    tele_hub.record_step(
+                        i, tap=tap_fn(stack_gar, sel),
+                        step_time_s=time.time() - t_step,
+                    )
             losses_seen = i + 1
             if (ckpt and args.checkpoint_freq
                     and (i + 1) % args.checkpoint_freq == 0):
-                ckpt.save(i + 1, {
-                    "flat": flat,
-                    "opt_state": jax.tree.map(np.asarray, opt_state),
-                    **({"bn": bn_mean} if bn_elems else {}),
-                })
+                with tele_trace.span("checkpoint", step=i):
+                    ckpt.save(i + 1, {
+                        "flat": flat,
+                        "opt_state": jax.tree.map(np.asarray, opt_state),
+                        **({"bn": bn_mean} if bn_elems else {}),
+                    })
                 last_saved = i + 1
             if args.acc_freq and i % args.acc_freq == 0:
-                acc = acc_eval(flat_dev)
+                with tele_trace.span("eval", step=i):
+                    acc = acc_eval(flat_dev)
                 print(
                     f"Step: {i} Accuracy: {acc:.4f} "
                     f"Time: {time.time() - t0:.1f}",
@@ -1323,13 +1341,15 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
         everyone = [
             r for r in plane.all_ranks if r != ex.my_index
         ] + list(worker_ranks)
-        frame = _encode_frame([vec], wire_stats, fanout=len(everyone))
-        ex.publish(i, frame, to=everyone)
+        with tele_trace.span("broadcast", step=i):
+            frame = _encode_frame([vec], wire_stats, fanout=len(everyone))
+            ex.publish(i, frame, to=everyone)
         try:
-            models_p, models_bn = _collect_models(
-                ex, i, plane, timeout_ms, split,
-                stats=wire_stats, wait_fn=model_wait,
-            )
+            with tele_trace.span("model_gather", step=i):
+                models_p, models_bn = _collect_models(
+                    ex, i, plane, timeout_ms, split,
+                    stats=wire_stats, wait_fn=model_wait,
+                )
         except _Lapped as lap:
             # Resumed/straggled behind the peers: jump to their round; the
             # gather step there re-synchronizes the model (docstring). Any
@@ -1356,21 +1376,23 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
             bn_plane = _robust_stats(models_bn, plane.fps)
         w = None
         if collector is not None:
-            got = _async_gradient_quorum(
-                collector, i, q, policy,
-                lambda: ex.publish(i, frame, to=everyone),
-                timeout_ms, who,
-            )
+            with tele_trace.span("quorum", step=i):
+                got = _async_gradient_quorum(
+                    collector, i, q, policy,
+                    lambda: ex.publish(i, frame, to=everyone),
+                    timeout_ms, who,
+                )
             quorum, taus, w = _staleness_quorum(
                 got, i, q, policy, worker_ranks, who
             )
             rows = {k: got[k][1] for k in quorum}
         else:
-            got, good_ranks = _gradient_quorum(
-                ex, i, q, good_ranks, split,
-                lambda: ex.publish(i, frame, to=everyone),
-                timeout_ms, who, stats=wire_stats, wait_fn=grad_wait,
-            )
+            with tele_trace.span("quorum", step=i):
+                got, good_ranks = _gradient_quorum(
+                    ex, i, q, good_ranks, split,
+                    lambda: ex.publish(i, frame, to=everyone),
+                    timeout_ms, who, stats=wire_stats, wait_fn=grad_wait,
+                )
             grad_wait = None
             quorum = sorted(got)[:q]
             rows = {k: got[k] for k in quorum}
@@ -1389,55 +1411,61 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
                     i + 1, q, timeout_ms=timeout_ms, peers=good_ranks,
                     transform=grad_tf,
                 )
-        stack = jnp.stack([rows[k][0] for k in quorum])
-        if bn_elems:
-            # BN reconciliation mirrors the params: equal-weight blend of
-            # the peer replicas' robust-aggregated stats (published next
-            # round) with this quorum's fresh worker stats. Replicas see
-            # overlapping-but-different worker quorums, so without the
-            # plane term their BN states drift apart unboundedly; the 1/2
-            # contraction bounds the spread at O(one quorum's dispersion)
-            # while still tracking the live statistics (the on-mesh twin's
-            # pmean over the ps axis, parallel/byzsgd.py, is the
-            # limit-case of this blend).
-            bn = 0.5 * (bn_plane + _robust_stats(
-                np.stack([rows[k][1] for k in quorum]), f
-            ))
-        if w is not None and not np.all(w == 1.0):
-            stack_gar = stack * jnp.asarray(w)[:, None]
-            flat_dev, opt_state = ps_update_weighted(
-                flat_dev, opt_state, stack, jnp.asarray(w),
-                jnp.asarray(i, jnp.int32),
-            )
-        else:
-            stack_gar = stack
-            flat_dev, opt_state = ps_update(
-                flat_dev, opt_state, stack,
-                jnp.asarray(i, jnp.int32),
-            )
-        flat = np.asarray(flat_dev, np.float32)
+        with tele_trace.span("gar_apply", step=i):
+            stack = jnp.stack([rows[k][0] for k in quorum])
+            if bn_elems:
+                # BN reconciliation mirrors the params: equal-weight
+                # blend of the peer replicas' robust-aggregated stats
+                # (published next round) with this quorum's fresh worker
+                # stats. Replicas see overlapping-but-different worker
+                # quorums, so without the plane term their BN states
+                # drift apart unboundedly; the 1/2 contraction bounds
+                # the spread at O(one quorum's dispersion) while still
+                # tracking the live statistics (the on-mesh twin's pmean
+                # over the ps axis, parallel/byzsgd.py, is the
+                # limit-case of this blend).
+                with tele_trace.span("bn_stats", step=i):
+                    bn = 0.5 * (bn_plane + _robust_stats(
+                        np.stack([rows[k][1] for k in quorum]), f
+                    ))
+            if w is not None and not np.all(w == 1.0):
+                stack_gar = stack * jnp.asarray(w)[:, None]
+                flat_dev, opt_state = ps_update_weighted(
+                    flat_dev, opt_state, stack, jnp.asarray(w),
+                    jnp.asarray(i, jnp.int32),
+                )
+            else:
+                stack_gar = stack
+                flat_dev, opt_state = ps_update(
+                    flat_dev, opt_state, stack,
+                    jnp.asarray(i, jnp.int32),
+                )
+            flat = np.asarray(flat_dev, np.float32)
         wire_stats.flush(i)
         if tele_hub is not None:
-            sel = jnp.asarray(
-                [k - worker_ranks[0] for k in quorum], jnp.int32
-            )
-            tele_hub.record_step(
-                i, tap=tap_fn(stack_gar, sel),
-            )
+            with tele_trace.span("audit", step=i):
+                sel = jnp.asarray(
+                    [k - worker_ranks[0] for k in quorum], jnp.int32
+                )
+                tele_hub.record_step(
+                    i, tap=tap_fn(stack_gar, sel),
+                )
         losses_seen = i + 1
         if ckpt and args.checkpoint_freq and (i + 1) % args.checkpoint_freq == 0:
-            ckpt.save(i + 1, {
-                "flat": flat,
-                "opt_state": jax.tree.map(np.asarray, opt_state),
-                **({"bn": bn} if bn_elems else {}),
-            })
+            with tele_trace.span("checkpoint", step=i):
+                ckpt.save(i + 1, {
+                    "flat": flat,
+                    "opt_state": jax.tree.map(np.asarray, opt_state),
+                    **({"bn": bn} if bn_elems else {}),
+                })
             last_saved = i + 1
         if args.acc_freq and i % args.acc_freq == 0:
-            acc = parallel.compute_accuracy(
-                (unravel(flat_dev), bn_unravel(jnp.asarray(bn))),
-                lambda s, x: eval_fn(s[0], s[1], x),
-                test_batches, binary=args.dataset == "pima",
-            )
+            with tele_trace.span("eval", step=i):
+                acc = parallel.compute_accuracy(
+                    (unravel(flat_dev), bn_unravel(jnp.asarray(bn))),
+                    lambda s, x: eval_fn(s[0], s[1], x),
+                    test_batches, binary=args.dataset == "pima",
+                )
             print(
                 f"Step: {i} Accuracy: {acc:.4f} "
                 f"Time: {time.time() - t0:.1f}",
@@ -1788,42 +1816,46 @@ def _run_learn(args):
                 await_beacon(r, 1, b"ready", "ready beacon")
         for i in range(start_iter, args.num_iter):
             # --- gradient plane (phase 2i+2) -----------------------------
-            if atk_kind == "cohort":
-                rows = []
-                for j in range(atk_cohort):
-                    b = (i * atk_cohort + j) % num_batches
-                    gj, loss, ms = worker_grad(
+            with tele_trace.span("grad_compute", step=i):
+                if atk_kind == "cohort":
+                    rows = []
+                    for j in range(atk_cohort):
+                        b = (i * atk_cohort + j) % num_batches
+                        gj, loss, ms = worker_grad(
+                            flat_dev, ms, my_xs[b], my_ys[b],
+                            jax.random.fold_in(
+                                base_key, i * atk_cohort + j
+                            ),
+                        )
+                        rows.append(np.asarray(gj, np.float32))
+                    rows = np.stack(rows)
+                    if beta is not None:
+                        mom = (1.0 - beta) * rows + beta * (
+                            0.0 if mom is None else mom
+                        )
+                        rows = mom.astype(np.float32)
+                    g = attack(rows)
+                else:
+                    b = i % num_batches
+                    g, loss, ms = worker_grad(
                         flat_dev, ms, my_xs[b], my_ys[b],
-                        jax.random.fold_in(base_key, i * atk_cohort + j),
+                        jax.random.fold_in(base_key, i),
                     )
-                    rows.append(np.asarray(gj, np.float32))
-                rows = np.stack(rows)
-                if beta is not None:
-                    mom = (1.0 - beta) * rows + beta * (
-                        0.0 if mom is None else mom
-                    )
-                    rows = mom.astype(np.float32)
-                g = attack(rows)
-            else:
-                b = i % num_batches
-                g, loss, ms = worker_grad(
-                    flat_dev, ms, my_xs[b], my_ys[b],
-                    jax.random.fold_in(base_key, i),
-                )
-                g = np.asarray(g, np.float32)
-                if beta is not None:
-                    mom = (1.0 - beta) * g + beta * (
-                        0.0 if mom is None else mom
-                    )
-                    g = mom.astype(np.float32)
-                if attack is not None:
-                    g = attack(g)
+                    g = np.asarray(g, np.float32)
+                    if beta is not None:
+                        mom = (1.0 - beta) * g + beta * (
+                            0.0 if mom is None else mom
+                        )
+                        g = mom.astype(np.float32)
+                    if attack is not None:
+                        g = attack(g)
             ex.publish(
                 2 * i + 2,
                 _encode_frame([g], wire_stats, fanout=n - 1),
             )
             try:
-                grads, _ = harvest(grad_wait, grad_split)
+                with tele_trace.span("quorum", step=i, plane="grad"):
+                    grads, _ = harvest(grad_wait, grad_split)
             except TimeoutError:
                 # Dropped out of the quorum flow: the reference's pull
                 # loops retry a bounded number of times then exit
@@ -1839,11 +1871,12 @@ def _run_learn(args):
                     "as a dropout (reference bounded-retry semantics)"
                 )
                 break
-            flat_dev, opt_state = node_update(
-                flat_dev, opt_state, grads,
-                jnp.asarray(i, jnp.int32),
-            )
-            flat = np.asarray(flat_dev, np.float32)
+            with tele_trace.span("update", step=i):
+                flat_dev, opt_state = node_update(
+                    flat_dev, opt_state, grads,
+                    jnp.asarray(i, jnp.int32),
+                )
+                flat = np.asarray(flat_dev, np.float32)
             # --- model gossip plane (phase 2i+3) -------------------------
             # Gossip frames are [params || stats] (r5, VERDICT r4 #4): the
             # model GAR aggregates the params, the stats segment goes
@@ -1858,36 +1891,39 @@ def _run_learn(args):
                 ])
             if model_attack is not None:
                 pub = model_attack(pub).astype(np.float32)
-            ex.publish(
-                2 * i + 3,
-                _encode_frame([pub], wire_stats, fanout=n - 1),
-            )
-            try:
-                models_p, models_bn = harvest(model_wait, gossip_split)
-            except TimeoutError:
-                tools.warning(
-                    f"[{who}] lost the round-{i} model-gossip quorum; "
-                    "keeping the locally updated model this round"
+            with tele_trace.span("gossip", step=i):
+                ex.publish(
+                    2 * i + 3,
+                    _encode_frame([pub], wire_stats, fanout=n - 1),
                 )
-                models_p = None
-            if models_p is not None:
-                flat_dev = model_aggregate(
-                    models_p, jnp.asarray(i, jnp.int32),
-                )
-                flat = np.asarray(flat_dev, np.float32)
-                if bn_elems:
-                    ms = bn_unravel(jnp.asarray(
-                        _robust_stats(models_bn, f)
-                    ))
+                try:
+                    models_p, models_bn = harvest(model_wait, gossip_split)
+                except TimeoutError:
+                    tools.warning(
+                        f"[{who}] lost the round-{i} model-gossip quorum; "
+                        "keeping the locally updated model this round"
+                    )
+                    models_p = None
+                if models_p is not None:
+                    flat_dev = model_aggregate(
+                        models_p, jnp.asarray(i, jnp.int32),
+                    )
+                    flat = np.asarray(flat_dev, np.float32)
+                    if bn_elems:
+                        ms = bn_unravel(jnp.asarray(
+                            _robust_stats(models_bn, f)
+                        ))
             wire_stats.flush(i)
             if (ckpt and args.checkpoint_freq
                     and (i + 1) % args.checkpoint_freq == 0):
-                ckpt.save(i + 1, {
-                    "flat": flat,
-                    "opt_state": jax.tree.map(np.asarray, opt_state),
-                    **({"bn": np.asarray(ravel_pytree(ms)[0], np.float32)}
-                       if bn_elems else {}),
-                })
+                with tele_trace.span("checkpoint", step=i):
+                    ckpt.save(i + 1, {
+                        "flat": flat,
+                        "opt_state": jax.tree.map(np.asarray, opt_state),
+                        **({"bn": np.asarray(
+                            ravel_pytree(ms)[0], np.float32)}
+                           if bn_elems else {}),
+                    })
             # Register the NEXT round's waiters before the (potentially
             # slow — first-eval compile) accuracy pass: with no waiters
             # pending, the q fastest peers can run a whole round ahead and
@@ -1896,11 +1932,12 @@ def _run_learn(args):
             if i + 1 < args.num_iter:
                 next_waits = register_round(i + 1)
             if args.acc_freq and i % args.acc_freq == 0:
-                acc = parallel.compute_accuracy(
-                    (unravel(flat_dev), ms),
-                    lambda s, x: eval_fn(s[0], s[1], x),
-                    eval_set, binary=args.dataset == "pima",
-                )
+                with tele_trace.span("eval", step=i):
+                    acc = parallel.compute_accuracy(
+                        (unravel(flat_dev), ms),
+                        lambda s, x: eval_fn(s[0], s[1], x),
+                        eval_set, binary=args.dataset == "pima",
+                    )
                 print(
                     f"Step: {i} Accuracy: {acc:.4f} "
                     f"Time: {time.time() - t0:.1f}",
@@ -2003,6 +2040,13 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
     bn0_flat, bn_unravel = ravel_pytree(ms0)
     bn_elems = int(np.asarray(bn0_flat).size)
     who = f"cluster-worker-{windex}"
+    # Events-only telemetry for workers (no GAR runs here, so no taps):
+    # exchange waits, wire accounting and — with --trace — the
+    # model_wait/grad_compute/publish spans land in this role's own
+    # <who>.telemetry.jsonl, which is what lets telemetry.report
+    # reconstruct the cross-process round timeline (a PS-only stream
+    # cannot attribute a slow quorum to the worker that caused it).
+    tele_hub, tele_exp = _telemetry_open(args, who)
     wire_stats = _WireStats(who)
     split = (flat_np.size, bn_elems)
     # pass_empty: the PS's stop sentinel is an empty frame, not a codec
@@ -2045,56 +2089,66 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
         the scenario harness's reproducible slow-rank delay just before
         the publish."""
         nonlocal ms, mom, loss
-        if atk_kind == "cohort":
-            # Colluding attacker (byzWorker.py:114-125): compute the
-            # cohort's honest gradients locally on DISTINCT batches of
-            # the attacker's own shard, publish the collusion statistic.
-            # In a --worker_momentum deployment the honest workers
-            # publish EMA momenta, so the attacker simulates its
-            # cohort's MOMENTA and hides inside their (shrunken)
-            # variance — the on-mesh semantics and the strongest form of
-            # the attack the cclip defense is built for.
-            rows = []
-            for j in range(atk_cohort):
-                o = step * atk_cohort + j
-                key = jax.random.fold_in(base_key, o)
+        with tele_trace.span("grad_compute", step=int(step), refresh=int(r)):
+            if atk_kind == "cohort":
+                # Colluding attacker (byzWorker.py:114-125): compute the
+                # cohort's honest gradients locally on DISTINCT batches
+                # of the attacker's own shard, publish the collusion
+                # statistic. In a --worker_momentum deployment the
+                # honest workers publish EMA momenta, so the attacker
+                # simulates its cohort's MOMENTA and hides inside their
+                # (shrunken) variance — the on-mesh semantics and the
+                # strongest form of the attack the cclip defense is
+                # built for.
+                rows = []
+                for j in range(atk_cohort):
+                    o = step * atk_cohort + j
+                    key = jax.random.fold_in(base_key, o)
+                    if r:
+                        key = jax.random.fold_in(key, 1_000_003 + r)
+                    gj, loss_, ms_new = worker_grad(
+                        flat_params, ms, my_xs[(o + r) % num_batches],
+                        my_ys[(o + r) % num_batches], key,
+                    )
+                    loss, ms = loss_, ms_new
+                    rows.append(np.asarray(gj, np.float32))
+                rows = np.stack(rows)
+                if beta is not None:
+                    mom = (1.0 - beta) * rows + beta * (
+                        0.0 if mom is None else mom
+                    )
+                    rows = mom.astype(np.float32)
+                g = attack(rows)
+            else:
+                key = jax.random.fold_in(base_key, step)
                 if r:
                     key = jax.random.fold_in(key, 1_000_003 + r)
-                gj, loss_, ms_new = worker_grad(
-                    flat_params, ms, my_xs[(o + r) % num_batches],
-                    my_ys[(o + r) % num_batches], key,
+                b = (step + r) % num_batches
+                g, loss_, ms_new = worker_grad(
+                    flat_params, ms, my_xs[b], my_ys[b], key,
                 )
                 loss, ms = loss_, ms_new
-                rows.append(np.asarray(gj, np.float32))
-            rows = np.stack(rows)
-            if beta is not None:
-                mom = (1.0 - beta) * rows + beta * (
-                    0.0 if mom is None else mom
+                g = np.asarray(g, np.float32)
+                if beta is not None:
+                    mom = (1.0 - beta) * g + beta * (
+                        0.0 if mom is None else mom
+                    )
+                    g = mom.astype(np.float32)
+                if attack is not None:
+                    g = attack(g)
+            out_parts = [g]
+            if bn_elems:
+                # Both deployment shapes ship [grad || stats] (MSMW BN
+                # plane, r5); the PS robust-aggregates the stats segment.
+                out_parts.append(
+                    np.asarray(ravel_pytree(ms)[0], np.float32)
                 )
-                rows = mom.astype(np.float32)
-            g = attack(rows)
-        else:
-            key = jax.random.fold_in(base_key, step)
-            if r:
-                key = jax.random.fold_in(key, 1_000_003 + r)
-            b = (step + r) % num_batches
-            g, loss_, ms_new = worker_grad(
-                flat_params, ms, my_xs[b], my_ys[b], key,
-            )
-            loss, ms = loss_, ms_new
-            g = np.asarray(g, np.float32)
-            if beta is not None:
-                mom = (1.0 - beta) * g + beta * (0.0 if mom is None else mom)
-                g = mom.astype(np.float32)
-            if attack is not None:
-                g = attack(g)
-        out_parts = [g]
-        if bn_elems:
-            # Both deployment shapes ship [grad || stats] (MSMW BN plane,
-            # r5); the PS robust-aggregates the stats segment.
-            out_parts.append(np.asarray(ravel_pytree(ms)[0], np.float32))
         if straggle_s:
-            time.sleep(straggle_s)  # injected slow rank (scenario knob)
+            # Injected slow rank (scenario knob) — its own span so the
+            # report attributes the delay instead of hiding it in the
+            # compute phase.
+            with tele_trace.span("straggle", step=int(step)):
+                time.sleep(straggle_s)
         targets = plane.all_ranks if multi_ps else ps_ranks
         ex.publish(
             step,
@@ -2113,10 +2167,11 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
         if multi_ps:
             step = i
             try:
-                models_p, models_bn = _collect_models(
-                    ex, i, plane, timeout_ms, split,
-                    stats=wire_stats, wait_fn=model_wait,
-                )
+                with tele_trace.span("model_gather", step=i):
+                    models_p, models_bn = _collect_models(
+                        ex, i, plane, timeout_ms, split,
+                        stats=wire_stats, wait_fn=model_wait,
+                    )
             except _Lapped as lap:
                 # MSMW catch-up: a worker outside the PSes' q-fastest
                 # quorum is lapped — jump to the plane's newest round
@@ -2224,6 +2279,7 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
         **({"refreshes": refreshes} if async_mode else {}),
         "final_loss": float(loss) if loss is not None else None,
     }
+    _telemetry_close(tele_hub, tele_exp)
     print(json.dumps({"tag": f"cluster-worker-{windex}", **summary}),
           flush=True)
     return summary

@@ -150,6 +150,16 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
            "defaults to ./telemetry. Costs one host sync + one extra "
            "selection pass per step; disabled (the default) it traces "
            "nothing and the trajectory is bitwise identical.")
+    a("--trace", action="store_true",
+      help="Distributed round tracing (docs/TELEMETRY.md §4): record "
+           "host-side SPANS for every phase of a round (broadcast, "
+           "quorum wait, waiter-thread wire decode + H2D, GAR compute, "
+           "apply, eval, checkpoint, ...) as schema-v5 records in the "
+           "telemetry JSONL. Implies --telemetry (spans need the sink); "
+           "host-only, so trajectories stay bitwise identical. Merge a "
+           "cluster run's per-role streams into a Chrome trace + run "
+           "report with `python -m garfield_tpu.telemetry.report DIR`. "
+           "Env twin: GARFIELD_TRACE=1.")
     a("--checkpoint_dir", type=str, default=None,
       help="Directory for orbax checkpoints (reference has none).")
     a("--checkpoint_freq", type=int, default=1000,
@@ -364,6 +374,12 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     # Telemetry plane (docs/TELEMETRY.md): hub + JSONL exporter, installed
     # as the process-global event sink so exchange/liveness events land in
     # the same stream as the per-step taps.
+    from ..telemetry import trace as trace_lib
+
+    if trace_lib.requested(args) and not getattr(args, "telemetry", None):
+        # Spans stream through the hub's JSONL sink; --trace without an
+        # explicit --telemetry gets the default directory.
+        args.telemetry = "telemetry"
     tele_hub = tele_exp = None
     if getattr(args, "telemetry", None):
         from ..telemetry import exporters as tele_fmt, hub as tele_hub_lib
@@ -393,6 +409,11 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
             os.path.join(args.telemetry, "telemetry.jsonl")
         )
         tele_exp.write(tele_fmt.make_record("run", meta=tele_hub.meta))
+        # Streaming sink (crash-safe): every record — per-step taps AND
+        # the trace spans below — drains to the JSONL as it is recorded.
+        tele_hub._sink = tele_exp
+        if trace_lib.requested(args):
+            trace_lib.enable(who=tag)
 
     def build(step):
         kwargs = dict(make_trainer_kwargs)
@@ -503,7 +524,12 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
             crash_steps=crash_steps, profile_step=profile_step,
         )
         profiling_this = profile_step is not None and i == profile_step
-        with profiling.trace(args.profile_dir if profiling_this else None):
+        # Span semantics without --bench: dispatch is asynchronous, so
+        # the span covers ENQUEUE time only (tag blocked=False); with
+        # --bench the block_until_ready makes it the honest device time.
+        with profiling.trace(args.profile_dir if profiling_this else None), \
+                trace_lib.span("dispatch", step=i, chunk=k,
+                               blocked=bool(args.bench)):
             if k == 1:
                 b = i % num_batches
                 if args.bench:
@@ -565,12 +591,13 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                     host_metrics if k == 1
                     else jax.tree.map(lambda l: l[j], host_metrics)
                 )
-                tele_exp.write(tele_hub.record_step(
+                # record_step drains to the JSONL via the hub's sink.
+                tele_hub.record_step(
                     i + j,
                     loss=float(m_j["loss"]),
                     tap=m_j.get("tap"),
                     step_time_s=timer.last() if args.bench else None,
-                ))
+                )
         if args.log:
             losses = np.asarray(metrics["loss"]).reshape(-1)
             for j in range(k):
@@ -598,9 +625,10 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                 # --bench promises honest per-step numbers; overlapped eval
                 # device work would execute inside the next timed window,
                 # so bench mode keeps eval inline.
-                _report(parallel.compute_accuracy(
-                    state, eval_fn, test_batches, binary=binary
-                ))
+                with trace_lib.span("eval", step=last):
+                    _report(parallel.compute_accuracy(
+                        state, eval_fn, test_batches, binary=binary
+                    ))
             else:
                 # Overlapped eval (reference's accuracy side thread): device
                 # work is enqueued here, the blocking readback happens off
@@ -611,7 +639,8 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                     after=eval_threads[-1] if eval_threads else None,
                 ))
         if ckpt and args.checkpoint_freq and end % args.checkpoint_freq == 0:
-            ckpt.save(end, jax.tree.map(np.asarray, state))
+            with trace_lib.span("checkpoint", step=end - 1):
+                ckpt.save(end, jax.tree.map(np.asarray, state))
         i = end
 
     jax.block_until_ready(state.step)  # drain async dispatch for honest wall
@@ -639,6 +668,8 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     if tele_hub is not None:
         from ..telemetry import exporters as tele_fmt, hub as tele_hub_lib
 
+        trace_lib.disable()
+        tele_hub._sink = None  # summary is written once, explicitly
         tele_exp.write(tele_hub.summary())
         with open(os.path.join(args.telemetry, "metrics.prom"), "w") as fp:
             fp.write(tele_fmt.prometheus_text(tele_hub))

@@ -31,6 +31,7 @@ import numpy as np
 from .. import data as data_lib, models as models_lib, parallel
 from ..parallel import learn
 from ..telemetry import MetricsHub, prometheus_text
+from ..telemetry import hub as tele_hub_lib, trace as tele_trace
 from ..utils import selectors, tools
 
 _PAGE = """<!doctype html>
@@ -60,6 +61,11 @@ _PAGE = """<!doctype html>
      GET /metrics (Prometheus text). -->
 <h4 style="margin-bottom:4px">GAR selection history (telemetry)</h4>
 <div id=hist style="font-family:monospace;font-size:11px"></div>
+<!-- Round-tracing phase breakdown (docs/TELEMETRY.md §4): where the
+     last completed round's wall clock went — one bar per traced phase
+     (dispatch/eval), widths proportional to seconds. -->
+<h4 style="margin-bottom:4px">Last round phase breakdown (tracing)</h4>
+<div id=phases style="font-family:monospace;font-size:11px"></div>
 <pre id=out>idle</pre>
 <script>
 async function start(ev) {
@@ -122,9 +128,19 @@ function drawHistory(r) {
     '<div style="color:#888">cell = per-step selection weight; ' +
     'red number = cumulative suspicion (exclusion frequency)</div>';
 }
+function drawPhases(r) {
+  const pb = r.phase_breakdown, el = document.getElementById('phases');
+  if (!pb || !pb.phases) { el.innerHTML = ''; return; }
+  const entries = Object.entries(pb.phases);
+  const total = entries.reduce((a, [, v]) => a + v, 0) || 1;
+  el.innerHTML = `<div>round ${pb.step}:</div>` + entries.map(([k, v]) =>
+    `<div>${k.padEnd ? k : k} <span style="display:inline-block;height:10px;`
+    + `background:#2980b9;width:${Math.max(2, 220 * v / total)}px"></span> `
+    + `${(v * 1e3).toFixed(2)} ms</div>`).join('');
+}
 async function poll() {
   const r = await (await fetch('/status')).json();
-  drawTopo(r); drawNodes(r); drawHistory(r);
+  drawTopo(r); drawNodes(r); drawHistory(r); drawPhases(r);
   document.getElementById('out').textContent = JSON.stringify(r, null, 1);
   if (r.running) setTimeout(poll, 500);
 }
@@ -187,6 +203,12 @@ def run_training(nodes, f, gar, attack, epochs, batch=16):
             meta={"tag": "demo", "gar": gar, "attack": attack, "f": f},
         )
         STATE.hub = hub
+        # Round tracing (docs/TELEMETRY.md §4): the demo always traces —
+        # its spans are in-process and cheap, and they feed the /status
+        # phase-breakdown panel + the garfield_phase_seconds histograms
+        # on /metrics.
+        tele_hub_lib.install(hub)
+        tele_trace.enable(who="demo")
         state = init_fn(jax.random.PRNGKey(1234), xs[0, 0])
         xs = jax.device_put(jax.numpy.asarray(xs), step_fn.batch_sharding)
         ys = jax.device_put(jax.numpy.asarray(ys), step_fn.batch_sharding)
@@ -198,9 +220,20 @@ def run_training(nodes, f, gar, attack, epochs, batch=16):
         metrics = {}
 
         def publish(i, metrics, running, done=False):
-            acc = parallel.compute_accuracy(state, eval_fn, test, binary=True)
+            with tele_trace.span("eval", step=i):
+                acc = parallel.compute_accuracy(
+                    state, eval_fn, test, binary=True
+                )
             susp = hub.suspicion()
+            lastp = hub.last_round_phases()
             STATE.update(
+                # Last COMPLETED round's phase breakdown (seconds) — the
+                # tracing satellite of ISSUE 8, rendered next to the
+                # suspicion panel.
+                phase_breakdown=(
+                    None if lastp is None
+                    else {"step": lastp[0], "phases": lastp[1]}
+                ),
                 running=running, step=i + 1, total=total,
                 epoch=i // iters_per_epoch,
                 loss=float(metrics["loss"]), accuracy=acc,
@@ -218,15 +251,20 @@ def run_training(nodes, f, gar, attack, epochs, batch=16):
             )
 
         for i in range(total):
-            state, metrics = step_fn(state, xs[:, i % iters_per_epoch],
-                                     ys[:, i % iters_per_epoch])
-            hub.record_step(i, loss=float(metrics["loss"]),
-                            tap=metrics.get("tap"))
+            with tele_trace.span("dispatch", step=i):
+                state, metrics = step_fn(state, xs[:, i % iters_per_epoch],
+                                         ys[:, i % iters_per_epoch])
+                loss_host = float(metrics["loss"])  # blocks on the step
+            hub.record_step(i, loss=loss_host, tap=metrics.get("tap"))
             if i % iters_per_epoch == 0 or i == total - 1:
                 publish(i, metrics, running=True)
         publish(total - 1, metrics, running=False, done=True)
     except Exception as exc:  # surfaced via /status, like demo.py's liveness
         STATE.update(running=False, error=repr(exc))
+    finally:
+        tele_trace.disable()
+        if tele_hub_lib.current() is STATE.hub:
+            tele_hub_lib.uninstall()
 
 
 class Handler(BaseHTTPRequestHandler):

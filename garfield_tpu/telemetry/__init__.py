@@ -32,9 +32,19 @@ from .exporters import (  # noqa: F401
     validate_jsonl,
     validate_record,
 )
-from .hub import MetricsHub, current, emit_event, install, uninstall  # noqa: F401
+from .hub import (  # noqa: F401
+    MetricsHub,
+    current,
+    emit_event,
+    emit_span,
+    install,
+    uninstall,
+)
+from . import trace  # noqa: F401  (span tracing, schema v5 — ISSUE 8)
 
 __all__ = [
+    "emit_span",
+    "trace",
     "JsonlExporter",
     "MetricsHub",
     "SCHEMA",

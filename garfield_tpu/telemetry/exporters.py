@@ -44,12 +44,20 @@ SCHEMA = "garfield-telemetry"
 # below), ``summary.staleness`` digest (count/mean/max/hist), and
 # ``exchange_bench`` rows may carry ``peak_rss_bytes`` plus the
 # straggler-scenario fields (``straggler_ms``, ``sync_round_s``,
-# ``async_round_s``, ``speedup``). Older records still validate —
-# consumers key on field presence, not version.
-SCHEMA_VERSION = 4
+# ``async_round_s``, ``speedup``). v5 (round 12, distributed round
+# tracing — telemetry/trace.py): the ``span`` kind (one timed phase of
+# a round: ``phase``, wall-clock start ``t_wall``, monotonic ``dur_s``,
+# optional ``step``/``who``/``tid`` tags — the raw material of
+# ``telemetry.report``'s causal timeline), ``summary`` gained the
+# optional ``spans`` count + per-phase ``phases`` digest, and
+# ``exchange_bench`` rows may carry per-phase ``phases`` percentiles
+# plus the tracing A/B fields (``trace_off_round_s``,
+# ``trace_on_round_s``, ``trace_overhead``). Older records still
+# validate — consumers key on field presence, not version.
+SCHEMA_VERSION = 5
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
-         "transfer_bench", "exchange_bench", "hier_bench")
+         "transfer_bench", "exchange_bench", "hier_bench", "span")
 
 
 def make_record(kind, **fields):
@@ -161,11 +169,49 @@ def validate_record(rec):
                     f"staleness.step must be a non-negative int, "
                     f"got {step!r}"
                 )
+    elif kind == "span":
+        # v5: one timed phase of a round (telemetry/trace.py).
+        if not isinstance(rec.get("phase"), str) or not rec["phase"]:
+            _fail(f"span.phase must be a non-empty string, "
+                  f"got {rec.get('phase')!r}")
+        for key in ("t_wall", "dur_s"):
+            if not _is_num(rec.get(key)):
+                _fail(f"span.{key} must be a number, got {rec.get(key)!r}")
+        if rec["dur_s"] < 0:
+            _fail(f"span.dur_s must be non-negative, got {rec['dur_s']!r}")
+        step = rec.get("step")
+        if step is not None and (
+            not isinstance(step, int) or isinstance(step, bool) or step < 0
+        ):
+            _fail(f"span.step must be a non-negative int or null, "
+                  f"got {step!r}")
+        who = rec.get("who")
+        if who is not None and not isinstance(who, str):
+            _fail(f"span.who must be a string or null, got {who!r}")
     elif kind == "summary":
         for key in ("steps", "events"):
             val = rec.get(key)
             if not isinstance(val, int) or isinstance(val, bool) or val < 0:
                 _fail(f"summary.{key} must be a non-negative int, got {val!r}")
+        spans = rec.get("spans")
+        if spans is not None and (
+            not isinstance(spans, int) or isinstance(spans, bool) or spans < 0
+        ):
+            _fail(f"summary.spans must be a non-negative int or null, "
+                  f"got {spans!r}")
+        phases = rec.get("phases")
+        if phases is not None:
+            # v5: per-phase span digest ({phase: {count/mean_s/...}}).
+            if not isinstance(phases, dict):
+                _fail(f"summary.phases must be an object, got {phases!r}")
+            for pk, pv in phases.items():
+                if not isinstance(pv, dict) or not all(
+                    _is_num(x) for x in pv.values()
+                ):
+                    _fail(
+                        f"summary.phases[{pk!r}] must map stat names to "
+                        f"numbers, got {pv!r}"
+                    )
         if rec.get("suspicion") is not None:
             _check_float_list("summary", "suspicion", rec["suspicion"])
         st = rec.get("step_time")
@@ -263,8 +309,23 @@ def validate_record(rec):
                 f"exchange_bench.wire must be a string, got "
                 f"{rec.get('wire')!r}"
             )
+        phases = rec.get("phases")
+        if phases is not None:
+            # v5: per-phase span percentiles on scenario / trace-A/B
+            # rows — the artifact attributes its speedups, not just
+            # reports them.
+            if not isinstance(phases, dict) or not all(
+                isinstance(v, dict) and all(_is_num(x) for x in v.values())
+                for v in phases.values()
+            ):
+                _fail(
+                    f"exchange_bench.phases must map phases to numeric "
+                    f"stat objects, got {phases!r}"
+                )
         for key in ("round_s", "wire_bytes_per_step", "straggler_ms",
-                    "sync_round_s", "async_round_s", "speedup"):
+                    "sync_round_s", "async_round_s", "speedup",
+                    "trace_off_round_s", "trace_on_round_s",
+                    "trace_overhead"):
             val = rec.get(key)
             if val is not None and not _is_num(val):
                 _fail(
@@ -348,6 +409,39 @@ def prometheus_text(hub):
                [({"quantile": "0.5"}, st["p50_s"]),
                 ({"quantile": "0.95"}, st["p95_s"]),
                 ({"quantile": "0.99"}, st["p99_s"])])
+    hists = hub.phase_histograms()
+    if hists:
+        # v5: per-phase round-time attribution (telemetry/trace.py) — a
+        # real Prometheus histogram per phase over the span durations,
+        # the per-phase twin of the step-time quantiles above (and the
+        # latency control signal the autoscaling work needs).
+        from .hub import PHASE_BUCKETS
+
+        lines.append(
+            "# HELP garfield_phase_seconds Wall time of each traced "
+            "round phase (spans, schema v5)."
+        )
+        lines.append("# TYPE garfield_phase_seconds histogram")
+        for phase, h in hists.items():
+            cum = 0
+            for le in PHASE_BUCKETS:
+                cum += h["buckets"].get(le, 0)
+                lines.append(
+                    f'garfield_phase_seconds_bucket'
+                    f'{{phase="{phase}",le="{le:g}"}} {cum}'
+                )
+            lines.append(
+                f'garfield_phase_seconds_bucket'
+                f'{{phase="{phase}",le="+Inf"}} {h["count"]}'
+            )
+            lines.append(
+                f'garfield_phase_seconds_sum{{phase="{phase}"}} '
+                f'{h["sum"]:g}'
+            )
+            lines.append(
+                f'garfield_phase_seconds_count{{phase="{phase}"}} '
+                f'{h["count"]}'
+            )
     w = hub.wire_counters()
     if any(w.values()):
         metric("garfield_wire_bytes_total", "counter",

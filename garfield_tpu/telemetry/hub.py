@@ -27,7 +27,15 @@ import numpy as np
 
 from .exporters import make_record
 
-__all__ = ["MetricsHub", "install", "uninstall", "current", "emit_event"]
+__all__ = ["MetricsHub", "install", "uninstall", "current", "emit_event",
+           "emit_span"]
+
+# Span-duration histogram buckets (seconds) for the Prometheus
+# ``garfield_phase_seconds`` exposition — log-spaced from wire-decode
+# scale (0.1 ms) to a straggler-dominated quorum wait (10 s).
+PHASE_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+)
 
 
 class MetricsHub:
@@ -73,6 +81,14 @@ class MetricsHub:
             "count": 0, "sum": 0, "max": 0,
             "hist": collections.Counter(),
         }
+        # Span accounting (schema v5, trace.py): per-phase duration
+        # digests for the exporters (Prometheus histogram, summary
+        # ``phases``) and a small per-round phase breakdown for the
+        # demo's /status panel. The raw spans stream to the sink like
+        # every other record; the hub keeps only bounded aggregates.
+        self._spans = 0
+        self._phase = {}            # phase -> {count,sum,buckets,durs}
+        self._round_phases = collections.OrderedDict()  # step -> {phase: s}
 
     # --- feeding -----------------------------------------------------------
 
@@ -189,6 +205,44 @@ class MetricsHub:
             self._drain(rec)
             return rec
 
+    def record_span(self, phase, *, t_wall, dur_s, **tags):
+        """Fold one trace span (schema v5, trace.py) into the hub: the
+        record streams to the sink, the duration lands in the per-phase
+        digest (Prometheus ``garfield_phase_seconds``), and — when the
+        span carries a ``step`` tag — in the per-round phase breakdown
+        behind ``last_round_phases`` (the demo's /status panel)."""
+        phase = str(phase)
+        dur = float(dur_s)
+        rec = make_record(
+            "span", phase=phase, t_wall=round(float(t_wall), 6),
+            dur_s=round(dur, 9), **tags,
+        )
+        with self._lock:
+            self._spans += 1
+            ph = self._phase.get(phase)
+            if ph is None:
+                ph = self._phase[phase] = {
+                    "count": 0, "sum": 0.0,
+                    "buckets": collections.Counter(),
+                    "durs": collections.deque(maxlen=2048),
+                }
+            ph["count"] += 1
+            ph["sum"] += dur
+            ph["durs"].append(dur)
+            for le in PHASE_BUCKETS:
+                if dur <= le:
+                    ph["buckets"][le] += 1
+                    break
+            step = tags.get("step")
+            if isinstance(step, int) and not isinstance(step, bool):
+                rp = self._round_phases.setdefault(step, {})
+                rp[phase] = rp.get(phase, 0.0) + dur
+                while len(self._round_phases) > 32:
+                    self._round_phases.popitem(last=False)
+            self._ring.append(rec)
+            self._drain(rec)
+            return rec
+
     def _drain(self, rec):
         if self._sink is not None:
             try:
@@ -219,6 +273,7 @@ class MetricsHub:
             return {
                 "steps": self._steps,
                 "events": self._events,
+                "spans": self._spans,
                 "loss": self._last_loss,
                 "tau": self._last_tau,
                 "clip_frac": self._last_clip_frac,
@@ -247,6 +302,56 @@ class MetricsHub:
                 )},
             }
 
+    def phase_stats(self):
+        """Per-phase duration percentiles over the recorded spans
+        ({phase: {count, mean_s, p50_s, p95_s, p99_s}}), or None before
+        any span — the per-phase twin of ``step_time_stats`` (and what
+        exchange_bench scenario rows record to attribute speedups)."""
+        with self._lock:
+            if not self._phase:
+                return None
+            out = {}
+            for phase in sorted(self._phase):
+                ph = self._phase[phase]
+                a = np.asarray(ph["durs"])
+                out[phase] = {
+                    "count": int(ph["count"]),
+                    "mean_s": float(ph["sum"] / ph["count"]),
+                    "p50_s": float(np.percentile(a, 50)),
+                    "p95_s": float(np.percentile(a, 95)),
+                    "p99_s": float(np.percentile(a, 99)),
+                }
+            return out
+
+    def phase_histograms(self):
+        """Per-phase {buckets: {le: count}, sum, count} — raw (non-
+        cumulative) bucket counts over PHASE_BUCKETS; the Prometheus
+        exporter renders the cumulative form."""
+        with self._lock:
+            return {
+                phase: {
+                    "buckets": dict(ph["buckets"]),
+                    "sum": float(ph["sum"]),
+                    "count": int(ph["count"]),
+                }
+                for phase, ph in sorted(self._phase.items())
+            }
+
+    def last_round_phases(self):
+        """(step, {phase: seconds}) for the last COMPLETED round — the
+        second-newest step seen in span tags (the newest may still be
+        mid-round) — or None before two rounds of spans. The demo's
+        /status phase-breakdown panel."""
+        with self._lock:
+            if not self._round_phases:
+                return None
+            steps = list(self._round_phases)
+            step = steps[-2] if len(steps) >= 2 else steps[-1]
+            return step, {
+                k: round(v, 6)
+                for k, v in sorted(self._round_phases[step].items())
+            }
+
     def step_time_stats(self):
         """count/mean/min/max plus p50/p95/p99 over the recorded step
         times (the chunking win — fewer, fatter dispatches — shows up in
@@ -269,11 +374,21 @@ class MetricsHub:
         """The run-closing JSONL record: suspicion, counters, timings."""
         susp = self.suspicion()
         stale = self.staleness_stats()
+        phases = self.phase_stats()
+        if phases is not None:
+            phases = {
+                k: {kk: round(vv, 6) for kk, vv in v.items()}
+                for k, v in phases.items()
+            }
         with self._lock:
             return make_record(
                 "summary",
                 steps=self._steps,
                 events=self._events,
+                # schema v5: per-phase span digest (None when no spans
+                # were recorded — tracing-off runs are unchanged).
+                spans=self._spans,
+                phases=phases,
                 loss=self._last_loss,
                 num_ranks=self.num_ranks,
                 suspicion=(
@@ -350,3 +465,14 @@ def emit_event(kind, **fields):
             hub.record_event(kind, **fields)
         except Exception:
             pass  # telemetry must never take down the data path
+
+
+def emit_span(phase, *, t_wall, dur_s, **tags):
+    """Span twin of ``emit_event`` (trace.py's emission path): a no-op
+    when no hub is installed, and never raises into the traced phase."""
+    hub = _GLOBAL
+    if hub is not None:
+        try:
+            hub.record_span(phase, t_wall=t_wall, dur_s=dur_s, **tags)
+        except Exception:
+            pass  # tracing must never take down the data path

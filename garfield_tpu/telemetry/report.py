@@ -1,0 +1,629 @@
+"""Cross-process run report: merge per-role traces, explain the rounds.
+
+``python -m garfield_tpu.telemetry.report RUN_DIR`` consumes the
+per-role ``<who>.telemetry.jsonl`` streams a ``--telemetry --trace``
+cluster run writes (or a single ``telemetry.jsonl`` from an on-mesh
+run) and emits the two artifacts that make the span plane worth having:
+
+  1. **Chrome trace-event JSON** (``trace.json``): every span as an
+     ``X`` event, one process lane per role, one thread lane per
+     recorded ``tid`` (the exchange waiter threads' eager decode+H2D
+     shows up OVERLAPPING the main loop's quorum wait — the PR-4
+     concurrency, finally visible). Open in Perfetto or
+     chrome://tracing.
+  2. **Markdown run report** (``report.md``): per-role per-phase
+     p50/p95/p99, per-round critical-path attribution on the reference
+     role (phases sum to the measured round time; the residual is
+     untraced host glue), a straggler ranking from cross-process
+     publish lateness cross-checked against MetricsHub suspicion, and
+     the async plane's stale-frame reuse rate.
+
+Clock model. Each span records its wall-clock START (``t_wall``,
+``time.time()``) and a MONOTONIC duration (``dur_s``). Durations are
+exact per process; cross-process placement needs the processes' wall
+clocks reconciled. The merger estimates one offset per role against
+the reference role (the PS) from **round-tag anchors** — causal
+constraints every round provides:
+
+  - a worker cannot finish receiving round i's model before the PS
+    began publishing it:   ``off >= ps_broadcast_start(i) - recv_end(i)``
+  - the PS cannot finish round i's quorum before the worker finished
+    publishing its round-i gradient: ``off <= ps_quorum_end(i) - pub_end(i)``
+
+The median lower/upper bounds over all shared rounds bracket the
+offset; 0 is used when admissible (co-located processes share a
+clock), else the bracket midpoint. The bracket width is the report's
+quoted **alignment error** — cross-process claims tighter than that
+are not supported by the data, and the per-round critical-path check
+is asserted only within it.
+
+Everything here is stdlib + the exporters' schema — no jax — and the
+output is DETERMINISTIC for a fixed input (pinned on the committed
+fixture by tests/test_trace.py): sorted keys, stable ordering, no
+wall-clock-of-now anywhere.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+__all__ = ["load_run", "build", "chrome_trace", "render_markdown", "main"]
+
+# Role-level phases that belong to the main loop's round accounting.
+# Exchange-internal spans (publish/collect/decode/gather/latest_wait)
+# nest inside them or live on waiter threads; the critical path keeps
+# OUTERMOST same-thread spans only, so listing the role vocabulary here
+# is documentation, not a filter.
+_RECV_PHASES = ("latest_wait", "model_gather", "model_wait")
+_PUB_PHASES = ("publish",)
+
+
+def _percentile(sorted_vals, p):
+    """Nearest-rank percentile on a pre-sorted list (deterministic,
+    no numpy — the report must run anywhere the artifacts land)."""
+    if not sorted_vals:
+        return None
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def load_run(paths):
+    """Parse telemetry JSONL streams into per-role dicts:
+    {role: {spans, events, summary, meta}}. ``paths`` is a directory
+    (every ``*.jsonl`` inside) or an explicit list of files."""
+    if isinstance(paths, (str, os.PathLike)):
+        d = str(paths)
+        if os.path.isdir(d):
+            paths = sorted(
+                os.path.join(d, f) for f in os.listdir(d)
+                if f.endswith(".jsonl")
+            )
+        else:
+            paths = [d]
+    roles = {}
+    for path in paths:
+        stem = os.path.basename(path)
+        for suffix in (".telemetry.jsonl", ".jsonl"):
+            if stem.endswith(suffix):
+                stem = stem[: -len(suffix)]
+                break
+        role = {"spans": [], "events": [], "summary": None, "meta": {},
+                "steps": []}
+        with open(path) as fp:
+            for line in fp:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                kind = rec.get("kind")
+                if kind == "span":
+                    role["spans"].append(rec)
+                elif kind == "event":
+                    role["events"].append(rec)
+                elif kind == "summary":
+                    role["summary"] = rec
+                elif kind == "run":
+                    role["meta"] = rec.get("meta") or {}
+                elif kind == "step":
+                    role["steps"].append(rec)
+        name = role["meta"].get("tag") or (
+            role["spans"][0].get("who") if role["spans"] else None
+        ) or stem
+        roles[str(name)] = role
+    return roles
+
+
+def _pick_reference(roles):
+    """The reference role: the PS (most 'broadcast' spans wins — MSMW
+    has several replicas), else the role with the most spans."""
+    def score(item):
+        name, r = item
+        n_bcast = sum(1 for s in r["spans"] if s["phase"] == "broadcast")
+        return (n_bcast, len(r["spans"]), name)
+
+    # max with name as the last tie-break keeps the choice deterministic.
+    name, _ = max(sorted(roles.items()), key=score)
+    return name
+
+
+def _phase_times(spans, phase, key="step"):
+    """{step: (start, end)} for the FIRST span of ``phase`` per step."""
+    out = {}
+    for s in spans:
+        st = s.get(key)
+        if s["phase"] == phase and isinstance(st, int) and st not in out:
+            out[st] = (s["t_wall"], s["t_wall"] + s["dur_s"])
+    return out
+
+
+def _recv_ends(spans):
+    """{round: recv_end} — when this role finished receiving the
+    round's model: latest_wait spans keyed by their harvested ``got``
+    tag (SSMW workers), else model_gather/model_wait spans by step."""
+    out = {}
+    for s in spans:
+        if s["phase"] == "latest_wait" and isinstance(s.get("got"), int):
+            r = s["got"]
+            end = s["t_wall"] + s["dur_s"]
+            if r not in out or end < out[r]:
+                out[r] = end
+    if out:
+        return out
+    for phase in ("model_gather", "model_wait"):
+        times = _phase_times(spans, phase)
+        if times:
+            return {r: e for r, (_, e) in times.items()}
+    return out
+
+
+def _fresh_rounds(roles, ref):
+    """{worker_index: set(rounds)} where the ref's ``staleness`` events
+    say the rank's frame was FRESH (staleness 0). The quorum-side upper
+    anchor is only causally valid for fresh frames: under async reuse
+    the PS can close round i's quorum on a worker's round i-k frame
+    BEFORE that worker ever publishes round i. None when the run has no
+    staleness events (synchronous: every consumed frame is fresh)."""
+    out = {}
+    seen = False
+    for ev in roles[ref]["events"]:
+        if ev.get("event") != "staleness":
+            continue
+        seen = True
+        step = ev.get("step")
+        for rank, tau in zip(ev.get("ranks") or (),
+                             ev.get("staleness") or ()):
+            if tau == 0 and isinstance(step, int):
+                out.setdefault(int(rank), set()).add(step)
+    return out if seen else None
+
+
+def _align(roles, ref):
+    """Per-role wall-clock offset (seconds to ADD to the role's clock)
+    + the causal bracket that bounds it. Returns
+    {role: {offset_s, lb_s, ub_s, anchors}}. The lower bound (cannot
+    receive before the send began) is always valid; the upper bound
+    (the PS closed the quorum after this worker's publish) holds only
+    for rounds where the worker's frame entered FRESH, so async runs
+    restrict it via the staleness events. An offset of 0 is preferred
+    whenever the bracket admits it (co-located processes share a
+    clock); otherwise the estimate is clamped into the bracket."""
+    ref_spans = roles[ref]["spans"]
+    bcast = _phase_times(ref_spans, "broadcast")
+    quorum = _phase_times(ref_spans, "quorum")
+    fresh = _fresh_rounds(roles, ref)
+    out = {ref: {"offset_s": 0.0, "lb_s": None, "ub_s": None, "anchors": 0}}
+    for name in sorted(roles):
+        if name == ref:
+            continue
+        spans = roles[name]["spans"]
+        recv = _recv_ends(spans)
+        pub = _phase_times(spans, "publish")
+        tail = name.rsplit("-", 1)[-1]
+        widx = int(tail) if tail.isdigit() else None
+        lbs, ubs = [], []
+        for r, (b_start, _) in bcast.items():
+            if r in recv:
+                lbs.append(b_start - recv[r])
+        for r, (_, q_end) in quorum.items():
+            if r not in pub:
+                continue
+            if fresh is not None and widx is not None and \
+                    r not in fresh.get(widx, ()):
+                continue  # stale reuse: the quorum never waited on r
+            ubs.append(q_end - pub[r][1])
+        lb = statistics.median(lbs) if lbs else None
+        ub = statistics.median(ubs) if ubs else None
+        if lb is not None and ub is not None and lb <= ub:
+            off = 0.0 if lb <= 0.0 <= ub else (lb + ub) / 2.0
+        elif lb is not None:
+            # No (valid) upper bound: clamp to the always-valid lower
+            # bound, preferring the shared-clock hypothesis.
+            off = 0.0 if lb <= 0.0 else lb
+        elif ub is not None:
+            off = 0.0 if ub >= 0.0 else ub
+        else:
+            off = 0.0
+        out[name] = {
+            "offset_s": off, "lb_s": lb, "ub_s": ub,
+            "anchors": min(len(lbs), len(ubs)) or max(len(lbs), len(ubs)),
+        }
+    return out
+
+
+def _main_tid(spans):
+    """The role's main-loop thread: the tid owning the most
+    step-tagged spans (waiter threads own the decode spans)."""
+    counts = {}
+    for s in spans:
+        if isinstance(s.get("step"), int):
+            counts[s.get("tid", 0)] = counts.get(s.get("tid", 0), 0) + 1
+    if not counts:
+        return 0
+    return sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[0][0]
+
+
+def _outermost(spans):
+    """Drop spans nested inside an earlier-kept span (same thread):
+    the critical path must not double-count quorum AND the collect it
+    wraps. Input must be sorted by start time."""
+    kept, horizon = [], None
+    for s in spans:
+        start, end = s["t_wall"], s["t_wall"] + s["dur_s"]
+        if horizon is not None and end <= horizon + 1e-9:
+            continue  # fully inside the previous outermost span
+        kept.append(s)
+        horizon = end if horizon is None else max(horizon, end)
+    return kept
+
+
+def _critical_path(roles, ref):
+    """Per-round attribution on the reference role's main thread:
+    [{round, measured_s, attributed_s, residual_s, phases: {p: s}}].
+    measured = start-to-start distance to the next round (the honest
+    round time the phases must sum to); the last round uses its own
+    span extent."""
+    spans = [s for s in roles[ref]["spans"]
+             if isinstance(s.get("step"), int)]
+    tid = _main_tid(spans)
+    spans = sorted(
+        (s for s in spans if s.get("tid", 0) == tid),
+        key=lambda s: (s["t_wall"], -s["dur_s"]),
+    )
+    by_round = {}
+    for s in spans:
+        by_round.setdefault(s["step"], []).append(s)
+    # A "round" whose only activity is bare exchange spans (publish/
+    # collect) is not a training round — e.g. the PS's stop-sentinel
+    # publish at step num_iter. Keeping it would both add a phantom row
+    # and stretch the previous round's start-to-start measurement over
+    # the whole run tail (final eval, checkpoint close).
+    role_phases = {"broadcast", "quorum", "gar_apply", "model_gather",
+                   "dispatch", "eval", "checkpoint", "grad_compute",
+                   "update", "gossip", "audit"}
+    by_round = {
+        r: ss for r, ss in by_round.items()
+        if any(s["phase"] in role_phases for s in ss)
+    }
+    rounds_sorted = sorted(by_round)
+    rows = []
+    for idx, r in enumerate(rounds_sorted):
+        outer = _outermost(by_round[r])
+        start = min(s["t_wall"] for s in outer)
+        end = max(s["t_wall"] + s["dur_s"] for s in outer)
+        if idx + 1 < len(rounds_sorted):
+            nxt = min(s["t_wall"] for s in by_round[rounds_sorted[idx + 1]])
+            measured = nxt - start
+        else:
+            measured = end - start
+        phases = {}
+        for s in outer:
+            phases[s["phase"]] = phases.get(s["phase"], 0.0) + s["dur_s"]
+        attributed = sum(phases.values())
+        rows.append({
+            "round": r,
+            "measured_s": round(measured, 6),
+            "attributed_s": round(attributed, 6),
+            "residual_s": round(measured - attributed, 6),
+            "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
+        })
+    return rows
+
+
+def _phase_digest(roles):
+    """{role: {phase: {count, p50_s, p95_s, p99_s, total_s}}}."""
+    out = {}
+    for name in sorted(roles):
+        durs = {}
+        for s in roles[name]["spans"]:
+            durs.setdefault(s["phase"], []).append(s["dur_s"])
+        out[name] = {}
+        for phase in sorted(durs):
+            vals = sorted(durs[phase])
+            out[name][phase] = {
+                "count": len(vals),
+                "p50_s": round(_percentile(vals, 50), 6),
+                "p95_s": round(_percentile(vals, 95), 6),
+                "p99_s": round(_percentile(vals, 99), 6),
+                "total_s": round(sum(vals), 6),
+            }
+    return out
+
+
+def _stragglers(roles, ref, offsets):
+    """Per-worker publish lateness vs the reference round start, with
+    the PS's suspicion score for the cross-check. Lateness for round i
+    = (worker publish end, aligned) - (ref round broadcast start);
+    the straggler is the rank whose median lateness tops the table."""
+    bcast = _phase_times(roles[ref]["spans"], "broadcast")
+    summary = roles[ref]["summary"] or {}
+    suspicion = summary.get("suspicion") or []
+    rows = []
+    workers = [n for n in sorted(roles) if "worker" in n]
+    for name in workers:
+        off = offsets.get(name, {}).get("offset_s", 0.0)
+        pub = _phase_times(roles[name]["spans"], "publish")
+        lates = [
+            (pub[r][1] + off) - bcast[r][0]
+            for r in pub if r in bcast
+        ]
+        if not lates:
+            continue
+        # worker index from the trailing -K of the role name when
+        # present (cluster-worker-K), for the suspicion cross-check.
+        widx = None
+        tail = name.rsplit("-", 1)[-1]
+        if tail.isdigit():
+            widx = int(tail)
+        rows.append({
+            "role": name,
+            "rounds": len(lates),
+            "median_lateness_s": round(statistics.median(lates), 6),
+            "p95_lateness_s": round(
+                _percentile(sorted(lates), 95), 6
+            ),
+            "suspicion": (
+                round(float(suspicion[widx]), 6)
+                if widx is not None and widx < len(suspicion) else None
+            ),
+        })
+    rows.sort(key=lambda r: (-r["median_lateness_s"], r["role"]))
+    return rows
+
+
+def _staleness(roles, ref):
+    """Stale-frame reuse digest from the reference role's ``staleness``
+    events (async runs; None on synchronous ones)."""
+    reused = members = rounds_n = 0
+    for ev in roles[ref]["events"]:
+        if ev.get("event") == "staleness":
+            rounds_n += 1
+            members += len(ev.get("ranks") or ())
+            reused += int(ev.get("reused") or 0)
+    if not rounds_n:
+        return None
+    return {
+        "rounds": rounds_n,
+        "quorum_members": members,
+        "reused_frames": reused,
+        "reuse_rate": round(reused / members, 6) if members else 0.0,
+    }
+
+
+def build(paths, ref=None):
+    """The full analysis dict every renderer consumes."""
+    roles = load_run(paths)
+    if not roles or all(not r["spans"] for r in roles.values()):
+        raise SystemExit(
+            "no span records found — run with --trace (or "
+            "GARFIELD_TRACE=1) and --telemetry, then point the report "
+            "at the run's telemetry directory"
+        )
+    ref = ref or _pick_reference(roles)
+    if ref not in roles:
+        raise SystemExit(
+            f"reference role {ref!r} not in {sorted(roles)}"
+        )
+    offsets = _align(roles, ref)
+    crit = _critical_path(roles, ref)
+    align_err = max(
+        (o["ub_s"] - o["lb_s"])
+        for o in offsets.values()
+        if o["lb_s"] is not None and o["ub_s"] is not None
+    ) if len(offsets) > 1 and any(
+        o["lb_s"] is not None and o["ub_s"] is not None
+        for o in offsets.values()
+    ) else 0.0
+    return {
+        "roles": roles,
+        "ref": ref,
+        "offsets": offsets,
+        "alignment_error_s": round(max(align_err, 0.0), 6),
+        "phases": _phase_digest(roles),
+        "critical_path": crit,
+        "stragglers": _stragglers(roles, ref, offsets),
+        "staleness": _staleness(roles, ref),
+    }
+
+
+def chrome_trace(analysis):
+    """Chrome trace-event JSON (the ``trace.json`` artifact): one
+    process lane per role, thread lanes per recorded tid, microsecond
+    timestamps relative to the earliest aligned span."""
+    roles = analysis["roles"]
+    offsets = analysis["offsets"]
+    t0 = min(
+        s["t_wall"] + offsets.get(name, {}).get("offset_s", 0.0)
+        for name, r in roles.items() for s in r["spans"]
+    )
+    events = []
+    for pid, name in enumerate(sorted(roles)):
+        events.append({
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": name},
+        })
+        off = offsets.get(name, {}).get("offset_s", 0.0)
+        for s in roles[name]["spans"]:
+            args = {
+                k: v for k, v in sorted(s.items())
+                if k not in ("schema", "v", "kind", "phase", "t_wall",
+                             "dur_s", "tid", "who")
+            }
+            events.append({
+                "ph": "X", "pid": pid, "tid": int(s.get("tid", 0)),
+                "name": s["phase"],
+                "ts": int(round((s["t_wall"] + off - t0) * 1e6)),
+                "dur": int(round(s["dur_s"] * 1e6)),
+                "args": args,
+            })
+    # Stable order: metadata first per process, then by timestamp.
+    events.sort(key=lambda e: (
+        e["pid"], 0 if e["ph"] == "M" else 1, e.get("ts", 0),
+        e.get("tid", 0), e["name"],
+    ))
+    return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+def _ms(v):
+    return "-" if v is None else f"{v * 1e3:.3f}"
+
+
+def render_markdown(analysis):
+    """The run report (``report.md``): deterministic for a fixed run."""
+    roles = analysis["roles"]
+    ref = analysis["ref"]
+    lines = ["# Garfield run report (distributed round tracing)", ""]
+    lines.append(
+        f"Roles: {', '.join(sorted(roles))} — reference: **{ref}**."
+    )
+    lines.append(
+        f"Clock-alignment error bound: "
+        f"{_ms(analysis['alignment_error_s'])} ms "
+        "(causal round-anchor bracket width; cross-process claims "
+        "tighter than this are not supported by the data)."
+    )
+    lines.append("")
+    lines.append("## Clock offsets (round-tag anchors)")
+    lines.append("")
+    lines.append("| role | offset (ms) | bracket lo | bracket hi | anchors |")
+    lines.append("|---|---|---|---|---|")
+    for name in sorted(analysis["offsets"]):
+        o = analysis["offsets"][name]
+        lines.append(
+            f"| {name} | {_ms(o['offset_s'])} | {_ms(o['lb_s'])} "
+            f"| {_ms(o['ub_s'])} | {o['anchors']} |"
+        )
+    lines.append("")
+    lines.append("## Per-phase latency (ms)")
+    for name in sorted(analysis["phases"]):
+        lines.append("")
+        lines.append(f"### {name}")
+        lines.append("")
+        lines.append("| phase | count | p50 | p95 | p99 | total |")
+        lines.append("|---|---|---|---|---|---|")
+        for phase, st in analysis["phases"][name].items():
+            lines.append(
+                f"| {phase} | {st['count']} | {_ms(st['p50_s'])} "
+                f"| {_ms(st['p95_s'])} | {_ms(st['p99_s'])} "
+                f"| {_ms(st['total_s'])} |"
+            )
+    crit = analysis["critical_path"]
+    lines.append("")
+    lines.append(f"## Per-round critical path ({ref})")
+    lines.append("")
+    if crit:
+        phases = sorted({p for row in crit for p in row["phases"]})
+        total_meas = sum(r["measured_s"] for r in crit)
+        total_attr = sum(r["attributed_s"] for r in crit)
+        lines.append(
+            f"{len(crit)} rounds, {total_meas * 1e3:.3f} ms measured, "
+            f"{total_attr * 1e3:.3f} ms attributed to phases "
+            f"({100.0 * total_attr / total_meas:.1f}% — the residual is "
+            "untraced host glue between spans)."
+        )
+        lines.append("")
+        header = "| round | measured | " + " | ".join(phases) + \
+            " | residual |"
+        lines.append(header)
+        lines.append("|---" * (len(phases) + 3) + "|")
+        for row in crit:
+            cells = [_ms(row["phases"].get(p, 0.0)) for p in phases]
+            lines.append(
+                f"| {row['round']} | {_ms(row['measured_s'])} | "
+                + " | ".join(cells)
+                + f" | {_ms(row['residual_s'])} |"
+            )
+        # Aggregate attribution: where does a round's wall clock GO?
+        lines.append("")
+        lines.append("| phase | total (ms) | share of measured |")
+        lines.append("|---|---|---|")
+        for p in phases:
+            tot = sum(r["phases"].get(p, 0.0) for r in crit)
+            lines.append(
+                f"| {p} | {_ms(tot)} | "
+                f"{100.0 * tot / total_meas:.1f}% |"
+            )
+        resid = total_meas - total_attr
+        lines.append(
+            f"| (residual) | {_ms(resid)} | "
+            f"{100.0 * resid / total_meas:.1f}% |"
+        )
+    else:
+        lines.append("No round-tagged spans on the reference role.")
+    lines.append("")
+    lines.append("## Straggler ranking (publish lateness vs suspicion)")
+    lines.append("")
+    if analysis["stragglers"]:
+        lines.append(
+            "| role | rounds | median lateness (ms) | p95 (ms) "
+            "| suspicion |"
+        )
+        lines.append("|---|---|---|---|---|")
+        for row in analysis["stragglers"]:
+            susp = "-" if row["suspicion"] is None else \
+                f"{row['suspicion']:.4f}"
+            lines.append(
+                f"| {row['role']} | {row['rounds']} "
+                f"| {_ms(row['median_lateness_s'])} "
+                f"| {_ms(row['p95_lateness_s'])} | {susp} |"
+            )
+    else:
+        lines.append("No worker publish spans found.")
+    lines.append("")
+    lines.append("## Stale-frame reuse (async plane)")
+    lines.append("")
+    st = analysis["staleness"]
+    if st is None:
+        lines.append("Synchronous run — no staleness events.")
+    else:
+        lines.append(
+            f"{st['rounds']} async rounds, {st['quorum_members']} quorum "
+            f"members, {st['reused_frames']} reused stale frames "
+            f"(reuse rate {100.0 * st['reuse_rate']:.1f}%)."
+        )
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Merge a traced run's per-role telemetry JSONL into "
+                    "a Chrome trace + markdown run report "
+                    "(docs/TELEMETRY.md §4)."
+    )
+    p.add_argument("run", nargs="+",
+                   help="telemetry directory of the run (or explicit "
+                        ".jsonl files)")
+    p.add_argument("--ref", default=None,
+                   help="reference role for alignment/critical path "
+                        "(default: the PS — most broadcast spans)")
+    p.add_argument("--trace-out", default=None,
+                   help="Chrome trace JSON path (default: "
+                        "<dir>/trace.json)")
+    p.add_argument("--md-out", default=None,
+                   help="markdown report path (default: <dir>/report.md)")
+    args = p.parse_args(argv)
+    src = args.run[0] if len(args.run) == 1 else list(args.run)
+    out_dir = src if isinstance(src, str) and os.path.isdir(src) else \
+        os.path.dirname(args.run[0]) or "."
+    analysis = build(src, ref=args.ref)
+    trace_path = args.trace_out or os.path.join(out_dir, "trace.json")
+    md_path = args.md_out or os.path.join(out_dir, "report.md")
+    with open(trace_path, "w") as fp:
+        json.dump(chrome_trace(analysis), fp, sort_keys=True,
+                  separators=(",", ":"))
+        fp.write("\n")
+    md = render_markdown(analysis)
+    with open(md_path, "w") as fp:
+        fp.write(md)
+    print(md)
+    print(f"[report] chrome trace: {trace_path}  (open in Perfetto / "
+          "chrome://tracing)", file=sys.stderr)
+    print(f"[report] markdown: {md_path}", file=sys.stderr)
+    return analysis
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -41,6 +41,7 @@ import threading
 import time
 
 from ..native import MultiBuffer
+from ..telemetry import trace as _trace
 
 __all__ = ["PeerExchange", "RoundCollector"]
 
@@ -271,27 +272,32 @@ class PeerExchange:
         RPC pulls.
         """
         payload = bytes(payload)
-        self._mb.write(self.my_index, _SLOT.pack(step) + payload)
-        frame = _HDR.pack(self.my_index, step, len(payload)) + payload
         targets = range(self.n) if to is None else to
-        for idx in targets:
-            if idx == self.my_index:
-                continue
-            q, _ = self._sender_for(idx)
-            while True:
-                try:
-                    q.put_nowait(frame)
-                    break
-                except queue.Full:
+        with _trace.span(
+            "publish", step=int(step), nbytes=len(payload),
+            fanout=len(targets) if to is not None else self.n - 1,
+        ):
+            self._mb.write(self.my_index, _SLOT.pack(step) + payload)
+            frame = _HDR.pack(self.my_index, step, len(payload)) + payload
+            for idx in targets:
+                if idx == self.my_index:
+                    continue
+                q, _ = self._sender_for(idx)
+                while True:
                     try:
-                        q.get_nowait()  # drop the oldest frame for this peer
-                        # ``step`` is the frame being ENQUEUED, not the
-                        # dropped one (the dropped frame's step is gone
-                        # with its bytes) — close enough to localize the
-                        # backpressure in the stream.
-                        _emit_send_drop(idx, step)
-                    except queue.Empty:
-                        pass
+                        q.put_nowait(frame)
+                        break
+                    except queue.Full:
+                        try:
+                            # drop the oldest frame for this peer.
+                            # ``step`` is the frame being ENQUEUED, not
+                            # the dropped one (the dropped frame's step
+                            # is gone with its bytes) — close enough to
+                            # localize the backpressure in the stream.
+                            q.get_nowait()
+                            _emit_send_drop(idx, step)
+                        except queue.Empty:
+                            pass
 
     # --- collect (wait-n-f) ------------------------------------------------
 
@@ -350,10 +356,17 @@ class PeerExchange:
                 if got_step == step:
                     payload = raw[_SLOT.size:]
                     if transform is not None:
-                        try:
-                            payload = transform(idx, payload)
-                        except Exception as exc:  # noqa: BLE001
-                            payload = exc
+                        # The eager decode+H2D runs HERE, on the waiter
+                        # thread — the span keeps it on its own trace
+                        # track so the report shows the overlap.
+                        with _trace.span(
+                            "decode", step=int(step), peer=int(idx),
+                            nbytes=len(payload),
+                        ):
+                            try:
+                                payload = transform(idx, payload)
+                            except Exception as exc:  # noqa: BLE001
+                                payload = exc
                     results[idx] = payload
                     break
                 if got_step > step:  # requested step already overwritten
@@ -417,28 +430,35 @@ class PeerExchange:
             t0 = time.monotonic()
             deadline_box[0] = t0 + timeout_ms / 1000.0
             hard = deadline_box[0] + 2.0
+            sp = _trace.span("collect", step=int(step), q=int(q))
             try:
-                for _ in range(len(peers)):
-                    if not sem.acquire(
-                        timeout=max(hard - time.monotonic(), 0.1)
-                    ):
-                        break
+                with sp:
+                    for _ in range(len(peers)):
+                        if not sem.acquire(
+                            timeout=max(hard - time.monotonic(), 0.1)
+                        ):
+                            break
+                        if len(results) >= q:
+                            sp.set(arrived=len(results))
+                            _emit_wait(
+                                step, q, len(results), time.monotonic() - t0
+                            )
+                            return dict(results)
                     if len(results) >= q:
+                        sp.set(arrived=len(results))
                         _emit_wait(
                             step, q, len(results), time.monotonic() - t0
                         )
                         return dict(results)
-                if len(results) >= q:
-                    _emit_wait(step, q, len(results), time.monotonic() - t0)
-                    return dict(results)
-                _emit_wait(
-                    step, q, len(results), time.monotonic() - t0,
-                    timed_out=True,
-                )
-                raise TimeoutError(
-                    f"only {len(results)}/{q} peers reached step {step} "
-                    f"within {timeout_ms} ms"
-                )
+                    sp.set(arrived=len(results), timed_out=True)
+                    _emit_wait(
+                        step, q, len(results), time.monotonic() - t0,
+                        timed_out=True,
+                    )
+                    raise TimeoutError(
+                        f"only {len(results)}/{q} peers reached step {step} "
+                        f"within {timeout_ms} ms"
+                    )
             finally:
                 # Single-harvest contract: whatever waiters are still
                 # blocked (beyond-quorum slots, give-ups in flight) are
@@ -507,10 +527,14 @@ class PeerExchange:
                 if got_step >= min_step:
                     payload = raw[_SLOT.size:]
                     if transform is not None:
-                        try:
-                            payload = transform(idx, payload)
-                        except Exception as exc:  # noqa: BLE001
-                            payload = exc
+                        with _trace.span(
+                            "decode", step=int(got_step), peer=int(idx),
+                            nbytes=len(payload),
+                        ):
+                            try:
+                                payload = transform(idx, payload)
+                            except Exception as exc:  # noqa: BLE001
+                                payload = exc
                     with cond:
                         state["best"] = (got_step, payload)
                         cond.notify_all()
@@ -522,20 +546,26 @@ class PeerExchange:
 
         def wait(timeout_ms=30_000):
             deadline = time.monotonic() + timeout_ms / 1000.0
-            with cond:
-                while state["best"] is None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0 or self._closing.is_set():
-                        break
-                    cond.wait(timeout=min(remaining, 1.0))
-                best = state["best"]
-            harvested.set()  # stop latching; the watcher exits on its own
-            if best is None:
-                raise TimeoutError(
-                    f"peer {idx} did not reach step {min_step} within "
-                    f"{timeout_ms} ms"
-                )
-            return best
+            sp = _trace.span(
+                "latest_wait", step=int(min_step), peer=int(idx),
+            )
+            with sp:
+                with cond:
+                    while state["best"] is None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0 or self._closing.is_set():
+                            break
+                        cond.wait(timeout=min(remaining, 1.0))
+                    best = state["best"]
+                harvested.set()  # stop latching; watcher exits on its own
+                if best is None:
+                    sp.set(timed_out=True)
+                    raise TimeoutError(
+                        f"peer {idx} did not reach step {min_step} within "
+                        f"{timeout_ms} ms"
+                    )
+                sp.set(got=int(best[0]))
+                return best
 
         wait.cancel = harvested.set
         return wait
@@ -742,10 +772,14 @@ class RoundCollector:
                 break
             payload = raw[_SLOT.size:]
             if self._transform is not None:
-                try:
-                    payload = self._transform(idx, payload)
-                except Exception as exc:  # noqa: BLE001 — ban evidence
-                    payload = exc
+                with _trace.span(
+                    "decode", step=int(got_step), peer=int(idx),
+                    nbytes=len(payload),
+                ):
+                    try:
+                        payload = self._transform(idx, payload)
+                    except Exception as exc:  # noqa: BLE001 — ban evidence
+                        payload = exc
             with self._cond:
                 if stop.is_set():
                     break  # removed while decoding: drop, don't resurrect
@@ -769,7 +803,11 @@ class RoundCollector:
         t0 = time.monotonic()
         deadline = t0 + timeout_ms / 1000.0
         lo = round_ - max_staleness
-        with self._cond:
+        sp = _trace.span(
+            "gather", step=int(round_), q=int(q),
+            max_staleness=int(max_staleness),
+        )
+        with sp, self._cond:
             while True:
                 adm = {
                     p: f for p, f in self._frames.items() if f[0] >= lo
@@ -778,12 +816,19 @@ class RoundCollector:
                     newest = max(g for _, _, g in adm.values())
                     if not require_fresh or newest > self._mark:
                         self._mark = max(self._mark, newest)
+                        sp.set(
+                            arrived=len(adm),
+                            reused=sum(
+                                1 for s, _, _ in adm.values() if s < round_
+                            ),
+                        )
                         _emit_wait(
                             round_, q, len(adm), time.monotonic() - t0
                         )
                         return {p: (s, pl) for p, (s, pl, _) in adm.items()}
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or self._ex._closing.is_set():
+                    sp.set(arrived=len(adm), timed_out=True)
                     _emit_wait(
                         round_, q, len(adm), time.monotonic() - t0,
                         timed_out=True,

@@ -1,0 +1,59 @@
+"""Schema-check every committed telemetry JSONL artifact.
+
+The BENCH_r05 post-mortem rule, mechanized: a bench capture that drifts
+from the telemetry schema must fail LOUDLY at commit time, not parse
+half-way in a later analysis session. This walks the repo root for
+``*_r*.jsonl`` artifacts (EXCHBENCH_r*, HIERBENCH_r*, ...) plus every
+committed fixture stream under ``tests/fixtures/``, and runs
+``telemetry.exporters.validate_jsonl`` over each — wired into tier-1 by
+``tests/test_trace.py::TestValidateArtifacts`` so schema drift in a
+future round fails the suite.
+
+  python scripts/validate_artifacts.py            # repo root auto-found
+  python scripts/validate_artifacts.py /some/repo
+"""
+
+import glob
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def find_artifacts(root=None):
+    """Sorted list of committed JSONL artifacts under ``root``: the
+    ``*_r*.jsonl`` bench captures at the top level and every fixture
+    ``*.jsonl`` under tests/fixtures/."""
+    root = root or _REPO
+    paths = sorted(glob.glob(os.path.join(root, "*_r*.jsonl")))
+    paths += sorted(glob.glob(
+        os.path.join(root, "tests", "fixtures", "**", "*.jsonl"),
+        recursive=True,
+    ))
+    return paths
+
+
+def main(root=None, argv=None):
+    if argv:
+        root = argv[0]
+    sys.path.insert(0, root or _REPO)
+    from garfield_tpu.telemetry import validate_jsonl
+
+    paths = find_artifacts(root)
+    if not paths:
+        print("validate_artifacts: no *_r*.jsonl artifacts found",
+              file=sys.stderr)
+        return 1
+    total = 0
+    for path in paths:
+        count = validate_jsonl(path)  # raises ValueError on drift
+        total += count
+        print(f"ok {os.path.relpath(path, root or _REPO)} "
+              f"({count} records)")
+    print(f"validate_artifacts: {len(paths)} artifacts, "
+          f"{total} records, all schema-valid")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(argv=sys.argv[1:]))
