@@ -72,6 +72,18 @@ from . import common
 
 __all__ = ["run"]
 
+# Logical exchange planes (DESIGN.md §15). Every typed data frame stamps
+# its plane into the wire codec header's spare bits (wire.encode plane=)
+# so bytes attribute per plane in telemetry; the LEARN async deployment
+# ADDITIONALLY uses them as real per-peer register slots
+# (PeerExchange(planes=3)) — the slot separation that removes the old
+# one-register-per-peer gossip multiplexing. The PS topologies keep a
+# single-plane transport (their planes are already separated by peer
+# role) and use the tags for accounting only.
+PLANE_CTRL = 0
+PLANE_GRAD = 1
+PLANE_MODEL = 2
+
 
 def _host_attack(name, params, fw):
     """Byzantine gradient attacks for a REAL attacker process.
@@ -252,8 +264,10 @@ def _eager_h2d():
 
 class _WireStats:
     """Per-role wire-plane accounting for the telemetry plane
-    (docs/TELEMETRY.md): bytes and codec seconds, both directions.
-    Receive-side appends happen on exchange waiter threads —
+    (docs/TELEMETRY.md): bytes and codec seconds, both directions,
+    broken down PER PLANE (schema v6 — the ``planes`` sub-object of the
+    per-step ``wire`` event feeds the plane-labelled Prometheus byte
+    counters). Receive-side appends happen on exchange waiter threads —
     ``list.append`` is GIL-atomic; the sums happen at the per-step
     ``flush`` on the role's main thread."""
 
@@ -262,43 +276,56 @@ class _WireStats:
         self._out = []
         self._in = []
 
-    def sent(self, nbytes, encode_s, fanout):
-        self._out.append((int(nbytes) * int(fanout), float(encode_s)))
+    def sent(self, nbytes, encode_s, fanout, plane=0):
+        self._out.append(
+            (int(nbytes) * int(fanout), float(encode_s), int(plane))
+        )
 
-    def received(self, nbytes, decode_s):
-        self._in.append((int(nbytes), float(decode_s)))
+    def received(self, nbytes, decode_s, plane=0):
+        self._in.append((int(nbytes), float(decode_s), int(plane)))
 
     def flush(self, step):
         out, self._out = self._out, []
         rin, self._in = self._in, []
         if tele_hooks.current() is None:
             return
+        planes = {}
+        for b, _, p in out:
+            planes.setdefault(p, [0, 0])[0] += b
+        for b, _, p in rin:
+            planes.setdefault(p, [0, 0])[1] += b
         tele_hooks.emit_event(
             "wire", who=self.who, step=int(step),
-            bytes_out=sum(b for b, _ in out),
-            bytes_in=sum(b for b, _ in rin),
+            bytes_out=sum(b for b, _, _ in out),
+            bytes_in=sum(b for b, _, _ in rin),
             frames_in=len(rin),
-            encode_s=round(sum(t for _, t in out), 6),
-            decode_s=round(sum(t for _, t in rin), 6),
+            encode_s=round(sum(t for _, t, _ in out), 6),
+            decode_s=round(sum(t for _, t, _ in rin), 6),
+            planes={
+                str(p): {"bytes_out": bo, "bytes_in": bi}
+                for p, (bo, bi) in sorted(planes.items())
+            },
         )
 
 
-def _encode_frame(parts, stats=None, fanout=1):
+def _encode_frame(parts, stats=None, fanout=1, plane=0):
     """The wire codec's single PRODUCER for the cluster driver: encode
     the concatenation of f32 segments (``[grad || stats]`` /
     ``[params || stats]``) as one typed frame at the configured
     ``GARFIELD_WIRE_DTYPE``, accounting bytes x fan-out and encode time
-    for the telemetry plane."""
+    for the telemetry plane. ``plane`` stamps the codec header's plane
+    tag (PLANE_GRAD/PLANE_MODEL) — the self-describing half of the
+    per-plane accounting."""
     t0 = time.perf_counter()
     parts = [np.asarray(p, np.float32).reshape(-1) for p in parts]
     vec = parts[0] if len(parts) == 1 else np.concatenate(parts)
-    frame = wire.encode(vec)
+    frame = wire.encode(vec, plane=plane)
     if stats is not None:
-        stats.sent(len(frame), time.perf_counter() - t0, fanout)
+        stats.sent(len(frame), time.perf_counter() - t0, fanout, plane)
     return frame
 
 
-def _frame_transform(split, stats=None, pass_empty=False):
+def _frame_transform(split, stats=None, pass_empty=False, plane=0):
     """The wire codec's single CONSUMER: the eager per-frame decode hook
     every cluster role hands to ``collect_begin``/``read_latest_begin``
     (the four roles used to hand-roll paired ``np.frombuffer`` splits
@@ -333,7 +360,7 @@ def _frame_transform(split, stats=None, pass_empty=False):
             except Exception:  # noqa: BLE001 — host row still works
                 pass  # jnp.stack uploads at harvest instead
         if stats is not None:
-            stats.received(len(payload), time.perf_counter() - t0)
+            stats.received(len(payload), time.perf_counter() - t0, plane)
         return head, tail
 
     return transform
@@ -426,6 +453,162 @@ def _staleness_quorum(got, i, q, policy, worker_ranks, who):
             reused=int((taus > 0).sum()),
         )
     return quorum, taus, w
+
+
+class _AutoscalePlane:
+    """PS-side elastic worker pool (DESIGN.md §15): the autoscale
+    controller (``utils/autoscale.py``) plus the mechanics of acting on
+    its decisions against a live async deployment.
+
+    Membership is three nested sets over the config's worker ranks:
+    the POOL (every worker slot in the cluster config — the reserve),
+    the ACTIVE set (processes this PS has spawned and not retired), and
+    the READY set (active ranks whose frames have actually reached a
+    quorum — a spawning worker pays tens of seconds of jax boot, and
+    counting it toward q before its first frame would stall every round
+    on its cold start). The effective quorum is
+    ``q = max(1, |ready ∩ active| - f)``.
+
+    SPAWN: launch the lowest reserve rank as a real OS process running
+    this PS's own CLI re-targeted at ``worker:K``
+    (``autoscale.worker_command``); it joins through the existing
+    ``read_latest`` catch-up path and re-reads its own shard. RETIRE: a
+    CLEAN teardown of the highest active rank — drop it from the
+    broadcast fan-out, send it the stop sentinel (it exits rc 0 through
+    its normal end-of-run path), retire its exchange watchers
+    (``PeerExchange.remove_peer`` — the symmetric-teardown contract) and
+    its collector membership. Every action emits the schema-v6
+    ``autoscale`` telemetry event; the hub folds the running
+    active-worker count into ``garfield_active_workers``.
+    """
+
+    def __init__(self, args, worker_ranks, f, gar, who):
+        from ..utils import autoscale as autoscale_lib
+
+        n_w = len(worker_ranks)
+        max_w = int(getattr(args, "autoscale_max", 0) or 0) or n_w
+        cfg = autoscale_lib.AutoscaleConfig(
+            target_rate=float(getattr(args, "target_rate", 0.0) or 0.0),
+            min_workers=int(getattr(args, "autoscale_min", 1) or 1),
+            max_workers=min(max_w, n_w),
+            window=int(getattr(args, "autoscale_window", 8) or 8),
+            cooldown=int(getattr(args, "autoscale_cooldown", 8) or 8),
+        )
+        q_min = max(1, cfg.min_workers - f)
+        if f:
+            msg = gar.check(np.zeros((q_min, 4), np.float32), f=f)
+            if msg is not None:
+                raise SystemExit(
+                    f"--autoscale_min {cfg.min_workers} is infeasible: "
+                    f"GAR {gar.name!r} cannot aggregate q = min - fw = "
+                    f"{q_min} rows: {msg}"
+                )
+        self.cfg = cfg
+        self.controller = autoscale_lib.AutoscaleController(cfg)
+        self.f = f
+        self.who = who
+        self.worker_ranks = list(worker_ranks)
+        self.base = worker_ranks[0]
+        self.active = list(worker_ranks[:cfg.min_workers])
+        self.ready = set()
+        self.ex = None
+        self.collector = None
+        self._procs = []
+        self._log_dir = getattr(args, "telemetry", None)
+
+    def bind(self, ex, collector):
+        self.ex = ex
+        self.collector = collector
+
+    def q(self):
+        live = len(self.ready & set(self.active)) or len(self.active)
+        return max(1, live - self.f)
+
+    def note_arrivals(self, ranks):
+        self.ready.update(r for r in ranks if r in self.active)
+
+    def _spawn_proc(self, windex):
+        import os
+        import subprocess
+        import sys
+
+        from ..utils import autoscale as autoscale_lib
+
+        cmd = autoscale_lib.worker_command(windex)
+        out = subprocess.DEVNULL
+        if self._log_dir:
+            os.makedirs(self._log_dir, exist_ok=True)
+            out = open(
+                os.path.join(self._log_dir, f"worker_{windex}.log"), "ab"
+            )
+        # A list, not a dict keyed by rank: a retire-then-respawn of the
+        # same rank must not drop the first process's handle unreaped.
+        self._procs.append(subprocess.Popen(
+            cmd, stdout=out, stderr=subprocess.STDOUT,
+            env=dict(os.environ),
+        ))
+
+    def spawn_initial(self):
+        """Launch the initial active set (with --autoscale the PS owns
+        its worker processes; external launches would double-bind the
+        configured ports)."""
+        for r in self.active:
+            self._spawn_proc(r - self.base)
+            self.collector.add_peer(r)
+
+    def observe(self, i, round_s, admissible):
+        """Fold one round into the controller and act on its decision."""
+        action = self.controller.observe(
+            round_s, active=len(self.active),
+            quorum_margin=admissible - self.q(),
+        )
+        if action == 0:
+            return
+        if action > 0:
+            reserve = [
+                r for r in self.worker_ranks if r not in self.active
+            ]
+            rank = reserve[0]
+            self.active = sorted(self.active + [rank])
+            self._spawn_proc(rank - self.base)
+            self.collector.add_peer(rank)
+            verb = "spawn"
+        else:
+            rank = self.active[-1]
+            self.active = [r for r in self.active if r != rank]
+            self.ready.discard(rank)
+            # Clean retire: stop sentinel first (the worker exits rc 0
+            # through its end-of-run path the moment its model watcher
+            # latches the empty frame), THEN the symmetric watcher
+            # teardown — collector membership and any exchange-level
+            # latches on the rank (read_latest probes) go together.
+            self.ex.publish(i + 1, b"", to=[rank])
+            self.collector.remove_peer(rank)
+            self.ex.remove_peer(rank)
+            verb = "retire"
+        rate = self.controller.rate()
+        tools.warning(
+            f"[{self.who}] autoscale {verb}: worker rank {rank} "
+            f"(active {len(self.active)}, target "
+            f"{self.controller.target:.2f} r/s)"
+        )
+        tele_hooks.emit_event(
+            "autoscale", who=self.who, step=int(i), action=verb,
+            rank=int(rank - self.base), active=len(self.active),
+            rate=None if rate is None else round(float(rate), 4),
+            target=round(float(self.controller.target), 4),
+        )
+
+    def reap(self, timeout=120):
+        """Join every process this PS spawned (the run's stop sentinel
+        has been published); kill stragglers after ``timeout``."""
+        import subprocess
+
+        for p in self._procs:
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
 
 
 def _setup(args):
@@ -584,7 +767,7 @@ def _gradient_quorum(ex, step, q, good_ranks, split, republish,
     ``(got, good_ranks)`` with every ``got`` value a decoded
     ``(grad_row, stats_row)`` pair.
     """
-    transform = _frame_transform(split, stats)
+    transform = _frame_transform(split, stats, plane=PLANE_GRAD)
     attempts = 0
     while True:
         try:
@@ -749,7 +932,7 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
     # in the exchange waiter threads (``_frame_transform``).
     wire_stats = _WireStats("cluster-ps")
     split = (flat.size, bn_elems)
-    grad_tf = _frame_transform(split, wire_stats)
+    grad_tf = _frame_transform(split, wire_stats, plane=PLANE_GRAD)
     # Bounded-staleness async mode (--async; DESIGN.md §14): ONE
     # persistent round-tagged collector replaces the per-round
     # collect_begin registrations — its multi-round watchers latch every
@@ -758,8 +941,27 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
     # re-collects.
     policy = rounds.resolve(args)
     collector = None
+    scaler = None
+    if getattr(args, "autoscale", False):
+        # Elastic worker pool (DESIGN.md §15): only composes with the
+        # async plane — a synchronous quorum's rate is pinned to its
+        # slowest member no matter how many workers exist, so scaling
+        # it is meaningless (and the membership mechanics live on the
+        # round collector).
+        if policy is None:
+            raise SystemExit(
+                "--autoscale requires --async: the synchronous quorum's "
+                "round rate does not scale with the worker count "
+                "(DESIGN.md §15)"
+            )
+        scaler = _AutoscalePlane(args, worker_ranks, f, gar, "cluster-ps")
     if policy is not None:
-        collector = ex.round_collector(worker_ranks, transform=grad_tf)
+        collector = ex.round_collector(
+            scaler.active if scaler else worker_ranks, transform=grad_tf
+        )
+        if scaler is not None:
+            scaler.bind(ex, collector)
+            scaler.spawn_initial()
     # PS-side checkpoint/resume (utils/checkpoint.py — the deliberate
     # upgrade over the reference, which has none; the on-mesh analog with
     # sharded TrainState + bit-exact rng replay lives in common.train).
@@ -798,12 +1000,17 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
             )
         for i in range(start_iter, args.num_iter):
             t_step = time.time()
+            # Elastic membership (--autoscale): the broadcast fans out to
+            # the ACTIVE set and the quorum tracks the READY subset —
+            # both are just ``worker_ranks`` without a scaler.
+            targets = scaler.active if scaler else worker_ranks
+            q_round = scaler.q() if scaler else q
             with tele_trace.span("broadcast", step=i):
                 frame = _encode_frame(
                     [flat] + ([bn_mean] if bn_elems else []),
-                    wire_stats, fanout=len(worker_ranks),
+                    wire_stats, fanout=len(targets), plane=PLANE_MODEL,
                 )
-                ex.publish(i, frame, to=worker_ranks)
+                ex.publish(i, frame, to=targets)
             w = None
             if collector is not None:
                 # Bounded staleness (DESIGN.md §14): admissible frames —
@@ -812,12 +1019,14 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
                 # q compose the aggregate with decayed weights.
                 with tele_trace.span("quorum", step=i):
                     got = _async_gradient_quorum(
-                        collector, i, q, policy,
-                        lambda: ex.publish(i, frame, to=worker_ranks),
+                        collector, i, q_round, policy,
+                        lambda: ex.publish(i, frame, to=targets),
                         timeout_ms, "cluster-ps",
                     )
+                if scaler is not None:
+                    scaler.note_arrivals(got)
                 quorum, taus, w = _staleness_quorum(
-                    got, i, q, policy, worker_ranks, "cluster-ps"
+                    got, i, q_round, policy, worker_ranks, "cluster-ps"
                 )
                 rows = {k: got[k][1] for k in quorum}
             else:
@@ -890,6 +1099,11 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
                         i, tap=tap_fn(stack_gar, sel),
                         step_time_s=time.time() - t_step,
                     )
+            if scaler is not None:
+                # Load control (DESIGN.md §15): fold this round's wall
+                # time + admissibility margin into the controller; spawn/
+                # retire side effects happen here, between rounds.
+                scaler.observe(i, time.time() - t_step, len(got))
             losses_seen = i + 1
             if (ckpt and args.checkpoint_freq
                     and (i + 1) % args.checkpoint_freq == 0):
@@ -915,8 +1129,12 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
         if collector is not None:
             collector.close()
     # Stop sentinel: an empty frame at step num_iter tells every worker
-    # (including stragglers that skipped rounds) training is over.
+    # (including stragglers that skipped rounds) training is over. The
+    # full pool is addressed — retired autoscale ranks already exited and
+    # a dead rank costs one bounded sender queue.
     ex.publish(args.num_iter, b"", to=worker_ranks)
+    if scaler is not None:
+        scaler.reap()
     acc = acc_eval(flat_dev)
     if ckpt:
         if args.checkpoint_freq and last_saved != args.num_iter:
@@ -1086,7 +1304,7 @@ def _collect_models(ex, step, plane, timeout_ms, split, stats=None,
     model carries no stats).
     """
     who = plane.who
-    transform = _frame_transform(split, stats)
+    transform = _frame_transform(split, stats, plane=PLANE_MODEL)
     attempts = 0
     while True:
         try:
@@ -1288,8 +1506,8 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
     good_ranks = list(worker_ranks)
     wire_stats = _WireStats(who)
     split = (flat.size, bn_elems)
-    model_tf = _frame_transform(split, wire_stats)
-    grad_tf = _frame_transform(split, wire_stats)
+    model_tf = _frame_transform(split, wire_stats, plane=PLANE_MODEL)
+    grad_tf = _frame_transform(split, wire_stats, plane=PLANE_GRAD)
     # --async (DESIGN.md §14): bounded staleness applies to the WORKER
     # gradient plane only — the PS-replica model gather stays exact-round
     # (the ByzSGD fps contract is an agreement over one round's models;
@@ -1342,7 +1560,8 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
             r for r in plane.all_ranks if r != ex.my_index
         ] + list(worker_ranks)
         with tele_trace.span("broadcast", step=i):
-            frame = _encode_frame([vec], wire_stats, fanout=len(everyone))
+            frame = _encode_frame([vec], wire_stats, fanout=len(everyone),
+                                  plane=PLANE_MODEL)
             ex.publish(i, frame, to=everyone)
         try:
             with tele_trace.span("model_gather", step=i):
@@ -1533,23 +1752,23 @@ def _run_learn(args):
     --model_attack it also poisons its gossiped model (the LEARN-side
     byzServer analog). A SIGKILLed node simply stops publishing and every
     survivor's wait-n-f quorum flows around it.
+
+    ``--async`` (DESIGN.md §15): bounded-staleness gossip over PER-PLANE
+    register slots. The old single-slot multiplexing (which is what made
+    LEARN reject --async through r11 — a round-tagged watcher could not
+    hold a stale gradient once its publisher gossiped the model over it)
+    is replaced by a 3-plane exchange: control beacons on plane 0,
+    gradients on PLANE_GRAD, models on PLANE_MODEL, each with its own
+    persistent ``RoundCollector``. Per round each node PUBLISHES-AND-
+    CONTINUES on both planes: it gathers the freshest q = n - f
+    admissible plane-tagged frames per phase (stale frames within
+    ``--max_staleness`` are REUSED with ``utils/rounds.py`` discount
+    weights composed into the stack before the rule — the same
+    Kardam-style law the PS plane applies, so one slow node stops
+    setting every honest node's pace), and ``--max_staleness 0`` is
+    bitwise the synchronous trajectory (exact-round admission, all
+    weights exactly 1.0, the unweighted jit programs).
     """
-    if rounds.resolve(args) is not None:
-        # LEARN multiplexes BOTH planes (gradients at 2i+2, models at
-        # 2i+3) on one last-writer-wins register slot per peer, so a
-        # round-tagged multi-round watcher cannot hold a stale gradient
-        # once its publisher gossips the model — and decentralized
-        # bounded staleness additionally needs the agreement rounds to
-        # keep honest models from drifting. Fail loudly instead of
-        # silently running synchronous (DESIGN.md §14 scopes the async
-        # plane to the PS topologies; LEARN's per-node wait-n-f already
-        # flows around stragglers).
-        raise SystemExit(
-            "--async is not supported on LEARN node deployments (the "
-            "gossip register multiplexes both planes per peer; see "
-            "DESIGN.md §14) — run the SSMW/MSMW cluster shapes async, "
-            "or rely on LEARN's built-in wait-n-f straggler tolerance"
-        )
     cfg = multihost.ClusterConfig(args.cluster)
     if args.task:
         ttype, _, tidx = args.task.partition(":")
@@ -1570,13 +1789,21 @@ def _run_learn(args):
                 f"GAR {args.gar!r} cannot run on the q = n - fw = {q} "
                 f"collected rows: {msg}"
             )
+    # Bounded-staleness async gossip (--async, DESIGN.md §15): the
+    # exchange grows per-plane register slots — control beacons keep
+    # plane 0, gradients and models each get their own slot per peer, so
+    # the planes stop overwriting each other in the last-writer-wins
+    # register (the multiplexing limitation that made LEARN reject
+    # --async through r11).
+    policy = rounds.resolve(args)
     # The exchange (and the stage-1 liveness hello, below) must exist
     # BEFORE any heavy local work: model init + data staging compile for
     # minutes on a loaded host, and a peer's barrier read cannot see that
     # (r5 — observed 4 co-located ResNet-class inits blowing the fixed
     # barrier budget when the hello waited for them).
     ex = PeerExchange(
-        cfg.process_id, cfg.hosts, connect_retry_ms=_startup_ms(args)
+        cfg.process_id, cfg.hosts, connect_retry_ms=_startup_ms(args),
+        planes=3 if policy is not None else 1,
     )
     ex.publish(0, b"up")
     xs, ys, test_batches, iters_per_epoch = common.load_data(args, n)
@@ -1612,8 +1839,7 @@ def _run_learn(args):
         grads, (loss, new_ms) = grad_fn(unravel(flat_params), ms, x, y, rng)
         return ravel_pytree(grads)[0], loss, new_ms
 
-    @jax.jit
-    def node_update(flat_params, opt_state, grads_stack, step):
+    def _node_update_body(flat_params, opt_state, grads_stack, step):
         agg = gar.unchecked(
             grads_stack, f=f,
             key=jax.random.fold_in(gar_base_key, step), **gar_params,
@@ -1624,14 +1850,36 @@ def _run_learn(args):
         )
         return ravel_pytree(optax.apply_updates(params, updates))[0], opt_state
 
-    @jax.jit
-    def model_aggregate(models_stack, step):
+    node_update = jax.jit(_node_update_body)
+    # Staleness-weighted twins (DESIGN.md §15) — the PS plane's
+    # composition verbatim: discount weights scale the rows BEFORE the
+    # rule; an all-fresh quorum dispatches the unweighted programs above,
+    # which is the --max_staleness 0 bitwise contract.
+    node_update_weighted = jax.jit(
+        lambda fp, ost, stack, w, step: _node_update_body(
+            fp, ost, stack * w[:, None], step
+        )
+    )
+
+    def _model_aggregate_body(models_stack, step):
         return model_gar.unchecked(
             models_stack, f=f,
             key=jax.random.fold_in(
                 jax.random.fold_in(gar_base_key, step), 1
             ),
         )
+
+    model_aggregate = jax.jit(_model_aggregate_body)
+    # Gossip-plane staleness composition (DESIGN.md §15): a stale model's
+    # row is discounted exactly like a stale gradient's — the robust
+    # model rule then treats the down-scaled row as the outlier it is and
+    # the fresh honest majority keeps its influence; all-fresh quorums
+    # dispatch the unweighted program above (the ms=0 bitwise contract).
+    model_aggregate_weighted = jax.jit(
+        lambda stack, w, step: _model_aggregate_body(
+            stack * w[:, None], step
+        )
+    )
 
     def harvest(wait_fn, split):
         """Drain a pre-registered quorum, stack the q lowest-rank
@@ -1668,10 +1916,81 @@ def _run_learn(args):
 
     who = f"cluster-node-{me}"
     warned_malformed = set()
+
+    def gather_rows(collector, i, split, phase):
+        """The bounded-staleness twin of ``harvest`` (one per-plane
+        ``RoundCollector``): admissible frames for round ``i`` — stale
+        within ``--max_staleness`` REUSED — composed as the freshest q
+        rows (ties on rank: at ms=0 this is exactly ``harvest``'s
+        lowest-rank composition), with ``utils/rounds.py`` discount
+        weights. Malformed frames (stored ``WireError``) retire the
+        peer's watcher (the PS plane's ban semantics, softened to
+        drop-and-flow like ``harvest``); zero rows pad below q. Emits the
+        per-round plane-tagged ``staleness`` telemetry event (schema v6)
+        whose discount deficits feed this node's suspicion ranking.
+        Returns ``(stack, bn_stack|None, weights|None)`` — weights None
+        when every admitted row is fresh, so the caller dispatches the
+        UNWEIGHTED jit program (the ms=0 bitwise contract)."""
+        got = collector.gather(
+            i, q, max_staleness=policy.max_staleness,
+            timeout_ms=args.cluster_timeout_ms,
+        )
+        d0, d1 = split
+        well = {}
+        for k, (tag, v) in got.items():
+            if isinstance(v, Exception):
+                if k not in warned_malformed:
+                    warned_malformed.add(k)
+                    tools.warning(
+                        f"[{who}] peer rank {k} sent a frame that failed "
+                        f"the wire codec ({v}); retiring its watcher "
+                        "(warned once)"
+                    )
+                    collector.remove_peer(k)
+            else:
+                well[k] = (tag, v)
+        quorum = sorted(well, key=lambda k: (i - well[k][0], k))[:q]
+        taus = [max(0, i - well[k][0]) for k in quorum]
+        rows = [well[k][1][0] for k in quorum]
+        bn_rows = [well[k][1][1] for k in quorum]
+        while len(rows) < q:
+            rows.append(np.zeros(d0, np.float32))
+            bn_rows.append(np.zeros(d1, np.float32))
+            taus.append(0)
+        w = np.asarray(
+            policy.weights(np.asarray(taus, np.int64)), np.float32
+        )
+        if tele_hooks.current() is not None:
+            # The audit covers EVERY admissible frame, not just the
+            # composed freshest-q quorum: a badly lagging peer rarely
+            # makes the quorum at all, and auditing only the quorum
+            # would hide exactly the rank the discount deficit exists
+            # to expose (its observed stale frames must keep feeding
+            # its suspicion even when fresher peers out-compose it).
+            adm = sorted(well)
+            adm_taus = np.asarray(
+                [max(0, i - well[k][0]) for k in adm], np.int64
+            )
+            adm_w = np.asarray(policy.weights(adm_taus), np.float32)
+            tele_hooks.emit_event(
+                "staleness", who=who, step=int(i), plane=phase,
+                ranks=[int(k) for k in adm],
+                staleness=[int(t) for t in adm_taus],
+                weights=[round(float(x), 6) for x in adm_w],
+                reused=int((adm_taus > 0).sum()),
+            )
+        return (
+            jnp.stack(rows),
+            (np.stack(bn_rows) if d1 else None),
+            (jnp.asarray(w) if not np.all(w == 1.0) else None),
+        )
+
     # Events-only telemetry for LEARN peers: the gossip quorums carry no
     # rank attribution after `harvest` stacks them, so this role streams
     # exchange wait latencies + liveness events (the audit taps live on
-    # the PS roles and the on-mesh topologies).
+    # the PS roles and the on-mesh topologies). Async mode additionally
+    # emits per-plane staleness events, whose discount deficits rank a
+    # straggling peer in this node's suspicion exactly like the PS's.
     tele_hub, tele_exp = _telemetry_open(args, who, num_ranks=n)
     t0 = time.time()
     base_key = jax.random.PRNGKey(args.seed + 1 + me)
@@ -1688,8 +2007,9 @@ def _run_learn(args):
     wire_stats = _WireStats(who)
     grad_split = (flat.size, 0)
     gossip_split = (flat.size, bn_elems)
-    grad_tf = _frame_transform(grad_split, wire_stats)
-    gossip_tf = _frame_transform(gossip_split, wire_stats)
+    grad_tf = _frame_transform(grad_split, wire_stats, plane=PLANE_GRAD)
+    gossip_tf = _frame_transform(gossip_split, wire_stats,
+                                 plane=PLANE_MODEL)
     # Per-node checkpoint/resume (r5): each peer persists its OWN model +
     # optimizer + BN stats under checkpoint_dir/node_{me}. Resume expects
     # the whole deployment to restart from a common step (the round-
@@ -1805,23 +2125,25 @@ def _run_learn(args):
                 ),
             )
 
-        # First round's waiters BEFORE our ready beacon (see the startup
-        # comment above): a peer can only start publishing rounds after it
-        # has seen this beacon, at which point our readers already latch.
-        grad_wait, model_wait = register_round(start_iter)
-        ex.publish(1, b"ready")
-        deadline = time.monotonic() + startup_ms / 1e3  # re-arm for stage 2
-        for r in range(n):
-            if r != me:
-                await_beacon(r, 1, b"ready", "ready beacon")
-        for i in range(start_iter, args.num_iter):
-            # --- gradient plane (phase 2i+2) -----------------------------
+        straggle_s = max(
+            0, int(getattr(args, "straggler_ms", 0) or 0)
+        ) / 1e3
+
+        def compute_grad(i):
+            """One local gradient for round ``i`` — the SAME derivation
+            on the sync and async paths (batch ``i % num_batches``, key
+            ``fold_in(base_key, i)``), which is what makes the two
+            trajectories comparable at all and bitwise-equal at ms=0.
+            Cohort attackers simulate their colluders from their own
+            extra batches; ``--straggler_ms`` injects the scenario
+            harness's reproducible slow node before the publish."""
+            nonlocal ms, mom
             with tele_trace.span("grad_compute", step=i):
                 if atk_kind == "cohort":
                     rows = []
                     for j in range(atk_cohort):
                         b = (i * atk_cohort + j) % num_batches
-                        gj, loss, ms = worker_grad(
+                        gj, _, ms = worker_grad(
                             flat_dev, ms, my_xs[b], my_ys[b],
                             jax.random.fold_in(
                                 base_key, i * atk_cohort + j
@@ -1837,7 +2159,7 @@ def _run_learn(args):
                     g = attack(rows)
                 else:
                     b = i % num_batches
-                    g, loss, ms = worker_grad(
+                    g, _, ms = worker_grad(
                         flat_dev, ms, my_xs[b], my_ys[b],
                         jax.random.fold_in(base_key, i),
                     )
@@ -1849,9 +2171,200 @@ def _run_learn(args):
                         g = mom.astype(np.float32)
                     if attack is not None:
                         g = attack(g)
+            if straggle_s:
+                # Injected slow node (scenario knob) — its own span so the
+                # trace report attributes the delay (see _run_worker).
+                with tele_trace.span("straggle", step=i):
+                    time.sleep(straggle_s)
+            return g
+
+        def async_rounds():
+            """The bounded-staleness round loop (--async, DESIGN.md §15):
+            publish-and-continue on BOTH per-plane collectors. A lost
+            gradient quorum still exits as a dropout (the sync
+            semantics); a lost gossip quorum keeps the local model for
+            one round. Returns ``dropped_at`` (None = completed).
+
+            CATCH-UP JUMP: unlike a PS worker (whose frame tags track
+            the PS broadcast through read_latest), a decentralized node
+            advances its round counter only by computing — a 10x
+            straggler would fall UNBOUNDEDLY behind the swarm in tag
+            space and leave every peer's admissible window permanently.
+            So a node whose counter lags the swarm clock (the newest tag
+            its gradient collector has seen) by more than the staleness
+            cutoff JUMPS to the swarm's round, skipping the rounds
+            nobody could consume: its contribution RATE stays what its
+            hardware allows, but its tags stay admissible and each fresh
+            frame it lands unlocks up to ``max_staleness`` rounds of
+            swarm progress — which is precisely where the fw=0 async
+            speedup over the synchronous wait-everyone pace comes from.
+            """
+            nonlocal flat, flat_dev, opt_state, ms, rounds_skipped
+            # Jump once the lag exceeds HALF the admissible window (>= 1
+            # so healthy in-phase pipelining — a peer can lawfully run
+            # one round ahead — never triggers it): the swarm throttles
+            # at exactly max_staleness behind its slowest required
+            # member, so a threshold AT the cutoff would never fire for
+            # the one node that needs it, and the straggler would grind
+            # every fw=0 quorum to its own pace — measured 1.25x instead
+            # of ~ms x. DISABLED at ms=0: the synchronous contract
+            # processes every round (there is no unbounded lag to escape
+            # — the exact-round quorum waits — and a jump would skip
+            # checkpoint rounds and break the bitwise equality).
+            jump_lag = (
+                max(1, policy.max_staleness // 2)
+                if policy.max_staleness > 0 else None
+            )
+            i = start_iter
+            while i < args.num_iter:
+                newest = grad_col.newest() if jump_lag is not None else None
+                if newest is not None and newest - i > jump_lag:
+                    jump = min(int(newest), args.num_iter - 1)
+                    rounds_skipped += jump - i
+                    tools.warning(
+                        f"[{who}] {jump - i} rounds behind the swarm "
+                        f"clock; jumping from round {i} to {jump} "
+                        f"(total skipped: {rounds_skipped})"
+                    )
+                    i = jump
+                g = compute_grad(i)
+                ex.publish(
+                    i,
+                    _encode_frame([g], wire_stats, fanout=n - 1,
+                                  plane=PLANE_GRAD),
+                    plane=PLANE_GRAD,
+                )
+                try:
+                    with tele_trace.span("quorum", step=i, plane="grad"):
+                        grads, _, w = gather_rows(
+                            grad_col, i, grad_split, "grad"
+                        )
+                except TimeoutError:
+                    tools.warning(
+                        f"[{who}] no admissible round-{i} gradient quorum "
+                        f"within the staleness cutoff; exiting as a "
+                        "dropout (reference bounded-retry semantics)"
+                    )
+                    return i
+                with tele_trace.span("update", step=i):
+                    if w is not None:
+                        flat_dev, opt_state = node_update_weighted(
+                            flat_dev, opt_state, grads, w,
+                            jnp.asarray(i, jnp.int32),
+                        )
+                    else:
+                        flat_dev, opt_state = node_update(
+                            flat_dev, opt_state, grads,
+                            jnp.asarray(i, jnp.int32),
+                        )
+                    flat = np.asarray(flat_dev, np.float32)
+                pub = flat
+                if bn_elems:
+                    pub = np.concatenate([
+                        flat, np.asarray(ravel_pytree(ms)[0], np.float32)
+                    ])
+                if model_attack is not None:
+                    pub = model_attack(pub).astype(np.float32)
+                with tele_trace.span("gossip", step=i):
+                    ex.publish(
+                        i,
+                        _encode_frame([pub], wire_stats, fanout=n - 1,
+                                      plane=PLANE_MODEL),
+                        plane=PLANE_MODEL,
+                    )
+                    try:
+                        models_p, models_bn, wm = gather_rows(
+                            model_col, i, gossip_split, "model"
+                        )
+                    except TimeoutError:
+                        tools.warning(
+                            f"[{who}] no admissible round-{i} gossip "
+                            "quorum; keeping the locally updated model "
+                            "this round"
+                        )
+                        models_p = None
+                    if models_p is not None:
+                        if wm is not None:
+                            flat_dev = model_aggregate_weighted(
+                                models_p, wm, jnp.asarray(i, jnp.int32),
+                            )
+                        else:
+                            flat_dev = model_aggregate(
+                                models_p, jnp.asarray(i, jnp.int32),
+                            )
+                        flat = np.asarray(flat_dev, np.float32)
+                        if bn_elems:
+                            ms = bn_unravel(jnp.asarray(
+                                _robust_stats(models_bn, f)
+                            ))
+                wire_stats.flush(i)
+                if (ckpt and args.checkpoint_freq
+                        and (i + 1) % args.checkpoint_freq == 0):
+                    with tele_trace.span("checkpoint", step=i):
+                        ckpt.save(i + 1, {
+                            "flat": flat,
+                            "opt_state": jax.tree.map(
+                                np.asarray, opt_state),
+                            **({"bn": np.asarray(
+                                ravel_pytree(ms)[0], np.float32)}
+                               if bn_elems else {}),
+                        })
+                if args.acc_freq and i % args.acc_freq == 0:
+                    with tele_trace.span("eval", step=i):
+                        acc = parallel.compute_accuracy(
+                            (unravel(flat_dev), ms),
+                            lambda s, x: eval_fn(s[0], s[1], x),
+                            eval_set, binary=args.dataset == "pima",
+                        )
+                    print(
+                        f"Step: {i} Accuracy: {acc:.4f} "
+                        f"Time: {time.time() - t0:.1f}",
+                        flush=True,
+                    )
+                i += 1
+            return None
+
+        # First round's waiters BEFORE our ready beacon (see the startup
+        # comment above): a peer can only start publishing rounds after it
+        # has seen this beacon, at which point our readers already latch.
+        # The async collectors are PERSISTENT multi-round watchers on
+        # their own planes — registered here for the same reason, and
+        # never re-registered again.
+        grad_col = model_col = None
+        grad_wait = model_wait = None
+        rounds_skipped = 0
+        if policy is not None:
+            grad_col = ex.round_collector(
+                range(n), transform=grad_tf, plane=PLANE_GRAD
+            )
+            model_col = ex.round_collector(
+                range(n), transform=gossip_tf, plane=PLANE_MODEL
+            )
+        else:
+            grad_wait, model_wait = register_round(start_iter)
+        ex.publish(1, b"ready")
+        deadline = time.monotonic() + startup_ms / 1e3  # re-arm for stage 2
+        for r in range(n):
+            if r != me:
+                await_beacon(r, 1, b"ready", "ready beacon")
+        if policy is not None:
+            try:
+                dropped_at = async_rounds()
+            finally:
+                grad_col.close()
+                model_col.close()
+        # Synchronous round loop (the async path returned its rounds
+        # above; an empty iterable keeps the shared summary tail below).
+        sync_iters = (
+            range(start_iter, args.num_iter) if policy is None else ()
+        )
+        for i in sync_iters:
+            # --- gradient plane (phase 2i+2) -----------------------------
+            g = compute_grad(i)
             ex.publish(
                 2 * i + 2,
-                _encode_frame([g], wire_stats, fanout=n - 1),
+                _encode_frame([g], wire_stats, fanout=n - 1,
+                              plane=PLANE_GRAD),
             )
             try:
                 with tele_trace.span("quorum", step=i, plane="grad"):
@@ -1894,7 +2407,8 @@ def _run_learn(args):
             with tele_trace.span("gossip", step=i):
                 ex.publish(
                     2 * i + 3,
-                    _encode_frame([pub], wire_stats, fanout=n - 1),
+                    _encode_frame([pub], wire_stats, fanout=n - 1,
+                                  plane=PLANE_MODEL),
                 )
                 try:
                     models_p, models_bn = harvest(model_wait, gossip_split)
@@ -1955,6 +2469,9 @@ def _run_learn(args):
             "final_accuracy": acc,
             "steps": dropped_at if dropped_at is not None else args.num_iter,
             "dropped_at": dropped_at,
+            # Async catch-up jumps (a straggler contributes at its own
+            # rate but tracks the swarm clock): rounds it never computed.
+            **({"skipped": rounds_skipped} if policy is not None else {}),
             "wall_s": time.time() - t0,
         }
         _telemetry_close(tele_hub, tele_exp)
@@ -2051,7 +2568,8 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
     split = (flat_np.size, bn_elems)
     # pass_empty: the PS's stop sentinel is an empty frame, not a codec
     # frame — it must reach the loop's sentinel check undecoded.
-    model_tf = _frame_transform(split, wire_stats, pass_empty=True)
+    model_tf = _frame_transform(split, wire_stats, pass_empty=True,
+                                plane=PLANE_MODEL)
     num_batches = my_xs.shape[0]
     multi_ps = len(ps_ranks) > 1
     if multi_ps:
@@ -2152,7 +2670,8 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
         targets = plane.all_ranks if multi_ps else ps_ranks
         ex.publish(
             step,
-            _encode_frame(out_parts, wire_stats, fanout=len(targets)),
+            _encode_frame(out_parts, wire_stats, fanout=len(targets),
+                          plane=PLANE_GRAD),
             to=targets,
         )
 

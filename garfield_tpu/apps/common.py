@@ -83,6 +83,33 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
       help="Per-round geometric discount for --async: a gradient tau "
            "rounds stale enters the GAR scaled by decay**tau. Default: "
            "env GARFIELD_STALENESS_DECAY, else 0.5.")
+    a("--autoscale", action="store_true",
+      help="Load-driven worker autoscaling (DESIGN.md §15; --cluster PS "
+           "role, requires --async): the PS watches its round rate and "
+           "quorum margin and SPAWNS worker processes (reserve ranks "
+           "from the cluster config's worker list, launched with this "
+           "process's own CLI re-targeted at worker:K) or RETIRES them "
+           "(clean stop sentinel + watcher teardown; a later spawn "
+           "rejoins through read_latest and re-reads its shard) so the "
+           "deployment tracks --target_rate instead of a fixed n. With "
+           "--autoscale the PS launches its own initial workers — do "
+           "not start worker processes externally.")
+    a("--target_rate", type=float, default=0.0,
+      help="Autoscale throughput target in rounds/s; <= 0 (default) "
+           "auto-calibrates to the first measurement window's rate, so "
+           "the initial deployment's service level is held through load "
+           "spikes.")
+    a("--autoscale_min", type=int, default=1,
+      help="Fewest active workers the autoscaler may retire down to "
+           "(must keep the GAR feasible at q = min - fw).")
+    a("--autoscale_max", type=int, default=0,
+      help="Most workers the autoscaler may spawn; 0 (default) = every "
+           "worker slot in the cluster config.")
+    a("--autoscale_window", type=int, default=8,
+      help="Rounds per autoscale measurement window.")
+    a("--autoscale_cooldown", type=int, default=8,
+      help="Rounds between consecutive autoscale actions (the new "
+           "membership's steady state is measured, not the transient).")
     a("--straggler_ms", type=int, default=0,
       help="Scenario-injection knob (the straggler half of the async "
            "harness, exchange_bench --scenario): in cluster mode THIS "

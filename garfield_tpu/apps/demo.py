@@ -9,7 +9,8 @@ and training runs in a background thread publishing progress:
 
   POST /train {"nodes": 8, "f": 1, "gar": "median", "attack": "lie"}
   GET  /status -> {"running", "step", "total", "loss", "accuracy",
-                   "suspicion", "selection_history", ...}
+                   "suspicion", "selection_history", "active_workers",
+                   ...}
   GET  /metrics -> Prometheus text exposition of the telemetry hub
                    (telemetry/exporters.prometheus_text)
   GET  /       -> minimal HTML page driving the endpoints, with the
@@ -226,7 +227,12 @@ def run_training(nodes, f, gar, attack, epochs, batch=16):
                 )
             susp = hub.suspicion()
             lastp = hub.last_round_phases()
+            live = hub.active_workers()
             STATE.update(
+                # Active-worker count (schema v6): the autoscale gauge
+                # when an elastic run feeds this hub, else the demo's
+                # fixed node count.
+                active_workers=nodes if live is None else int(live),
                 # Last COMPLETED round's phase breakdown (seconds) — the
                 # tracing satellite of ISSUE 8, rendered next to the
                 # suspicion panel.

@@ -73,30 +73,34 @@ def main(argv=None):
         # exists only in the cluster deployment.
         raise SystemExit("--model_gar requires --cluster (node deployment)")
     assert args.fw * 2 < args.num_workers or args.fw == 0
-    if getattr(args, "async_agg", False):
-        from ..utils import tools
+    make_trainer_kwargs = dict(
+        num_nodes=args.num_workers,
+        f=args.fw,
+        attack=args.attack,
+        attack_params=args.attack_params,
+        model_attack=args.model_attack,
+        model_attack_params=args.model_attack_params,
+        non_iid=args.non_iid,
+        model_gossip=not args.no_model_gossip,
+        subset=args.subset,
+    )
+    from ..utils import rounds
 
-        tools.warning(
-            "[learn] --async is a PS-topology mode (SSMW/MSMW): LEARN's "
-            "gossip multiplexes both planes on one register slot per "
-            "peer, so bounded staleness does not apply — running "
-            "round-synchronous (its wait-n-f already flows around "
-            "stragglers)"
-        )
+    policy = rounds.resolve(args)
+    if policy is not None:
+        # On-mesh --async: the seeded in-graph emulation of the host
+        # plane's bounded-staleness gossip (parallel/learn ``staleness=``;
+        # DESIGN.md §15) — per-phase discount weights under the same law
+        # and flags as the cluster deployment (which runs the REAL
+        # per-plane protocol through apps/cluster._run_learn above).
+        make_trainer_kwargs["staleness"] = {
+            "max_staleness": policy.max_staleness,
+            "decay": policy.decay,
+        }
     return common.train(
         args,
         topology=learn,
-        make_trainer_kwargs=dict(
-            num_nodes=args.num_workers,
-            f=args.fw,
-            attack=args.attack,
-            attack_params=args.attack_params,
-            model_attack=args.model_attack,
-            model_attack_params=args.model_attack_params,
-            non_iid=args.non_iid,
-            model_gossip=not args.no_model_gossip,
-            subset=args.subset,
-        ),
+        make_trainer_kwargs=make_trainer_kwargs,
         num_slots=args.num_workers,
         tag="learn",
     )

@@ -295,7 +295,8 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
 
 
 def folded_tree_aggregate_multi(gar, plan, stacked_tree, *, f, keys=None,
-                                gar_params=None, subset_sels=None):
+                                gar_params=None, subset_sels=None,
+                                row_weights=None):
     """Per-OBSERVER folded aggregation: m wait-n-f views of ONE exchange.
 
     The decentralized topologies (LEARN phases 2/3/5, ByzSGD's model
@@ -317,6 +318,13 @@ def folded_tree_aggregate_multi(gar, plan, stacked_tree, *, f, keys=None,
       subset_sels: (m, q) per-observer row indices, or None for full
         participation (every observer sees all n rows — m identical
         selections, still one Gram).
+      row_weights: optional (n,) per-row scalars (may be traced) COMPOSED
+        with the fold exactly as in ``folded_tree_aggregate`` — the
+        bounded-staleness discount (``utils.rounds.staleness_weights``,
+        DESIGN.md §15): a row's staleness is a property of its PUBLISHER,
+        so one weight vector is shared by every observer, multiplying
+        into the remapped Gram and the per-observer weight rows through
+        the fold's own row-scale algebra.
 
     Returns the aggregated tree with a leading m axis. Rows non-finite in
     the raw stack are handled exactly as ``apply_rows``: a row selected
@@ -349,6 +357,12 @@ def folded_tree_aggregate_multi(gar, plan, stacked_tree, *, f, keys=None,
             stacked_tree, extra,
         )
     scale = jnp.asarray(scale_np)
+    if row_weights is not None:
+        # Staleness composition (DESIGN.md §15): per-row weights are row
+        # scales, so they multiply into the same algebra the attack plan
+        # uses — the remapped Gram below and every observer's weight row
+        # see the composed scale; nothing row-shaped materializes.
+        scale = scale * jnp.asarray(row_weights, scale.dtype)
     gram = tree_gram(ext)  # (n+k, n+k), ONE build for all observers
     gram_p = _sanitize_gram(
         gram[rmap][:, rmap] * (scale[:, None] * scale[None, :]), scale_np

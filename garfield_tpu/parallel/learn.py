@@ -113,6 +113,7 @@ def make_trainer(
     tree_path=True,
     num_iter=None,
     telemetry=False,
+    staleness=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the LEARN topology.
 
@@ -156,6 +157,22 @@ def make_trainer(
     gradient decision (``core.slot_path_decision``; the slot-FUSED twin is
     structurally inapplicable here — per-node params mean there is no
     single shared kernel for the fused forward to use).
+    ``staleness`` is the in-graph EMULATION of the host plane's
+    bounded-staleness async mode on the decentralized topology
+    (DESIGN.md §15) — the asynchrony analog of the seeded ``subset``
+    emulation, now PER PHASE: a dict with ``max_staleness`` (hard
+    cutoff, rounds), ``decay`` (geometric discount), and optional
+    ``taus`` (a FIXED per-node staleness assignment). Each exchange
+    PHASE — the phase-2 gradient gather, every agreement round, and the
+    phase-5 model gossip — draws its own seeded per-node staleness
+    (fixed ``taus`` apply to every phase) and scales the gathered rows
+    by ``utils.rounds.staleness_weights`` before the rule, composed into
+    the folded-attack row scales on Gram-form rules
+    (``fold.folded_tree_aggregate_multi`` ``row_weights``) so the fast
+    path survives; non-Gram rules route to the flat path, which weights
+    rows explicitly. At ``max_staleness=0`` (or all-zero ``taus``) the
+    machinery is dropped at build time and trajectories are BITWISE the
+    synchronous ones (tests/test_staleness.py).
     ``step_fn(state, x, y)``: leading ``num_nodes`` axis on x/y and on every
     params/opt_state leaf, all sharded over ``axis``.
     """
@@ -206,6 +223,49 @@ def make_trainer(
     gossip_tree_ok = grad_tree_ok and (
         model_attack in (None, "none") or model_fold_plan is not None
     )
+
+    # Bounded-staleness emulation (see docstring). Normalized at build so
+    # trivially-synchronous configs drop the machinery entirely — the step
+    # program is then literally the synchronous one (the bitwise half of
+    # the --max_staleness 0 contract, like aggregathor's normalization).
+    stale_ms = stale_decay = stale_weights_static = None
+    if staleness is not None:
+        import numpy as np
+
+        from ..utils import rounds as rounds_lib
+
+        st = dict(staleness)
+        stale_ms = int(st.pop(
+            "max_staleness", rounds_lib.DEFAULT_MAX_STALENESS
+        ))
+        stale_decay = float(st.pop("decay", rounds_lib.DEFAULT_DECAY))
+        taus = st.pop("taus", None)
+        if st:
+            raise ValueError(f"unknown staleness keys {sorted(st)}")
+        rounds_lib.StalenessPolicy(stale_ms, stale_decay)  # validate
+        if stale_ms == 0:
+            staleness = None  # all weights exactly 1: synchronous program
+        elif taus is not None:
+            taus = np.clip(np.asarray(taus, np.int64), 0, stale_ms)
+            if taus.shape != (num_nodes,):
+                raise ValueError(
+                    f"staleness taus must have shape ({num_nodes},), "
+                    f"got {taus.shape}"
+                )
+            stale_weights_static = rounds_lib.staleness_weights(
+                taus, decay=stale_decay, max_staleness=stale_ms
+            )
+            if np.all(stale_weights_static == 1.0):
+                staleness = None  # all-fresh schedule: same program
+        if staleness is not None and gar.gram_select is None:
+            # Row weights compose with the tree route only through the
+            # Gram algebra (fold row_weights); coordinate/iterative rules
+            # consume row values — route every exchange to the flat path,
+            # which weights the rows explicitly (the aggregathor rule).
+            grad_tree_ok = False
+            gossip_tree_ok = False
+        if staleness is not None:
+            stale_weights_fn = rounds_lib.staleness_weights
 
     init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
     # Per-slot gradient formulation (VERDICT r5 #3): LEARN consults the
@@ -270,6 +330,37 @@ def make_trainer(
         shard = jax.lax.axis_index(axis)
         node_ids = shard * per_n + jnp.arange(per_n)
 
+        def stale_w_for(phase_id):
+            """Per-PHASE bounded-staleness weights (emulation; see the
+            make_trainer docstring): the fixed ``taus`` schedule, or a
+            seeded per-phase draw — each exchange phase (gradients, every
+            agreement round, gossip) samples its own per-node staleness,
+            like the host plane's per-plane gathers. fold_in-derived (NOT
+            an extra split) so synchronous configs' key derivation — and
+            every pinned trajectory — is untouched."""
+            if staleness is None:
+                return None
+            if stale_weights_static is not None:
+                return jnp.asarray(stale_weights_static)
+            taus = jax.random.randint(
+                jax.random.fold_in(
+                    jax.random.fold_in(base, 0x57A1E), phase_id
+                ),
+                (num_nodes,), 0, stale_ms + 1,
+            )
+            return stale_weights_fn(
+                taus, decay=stale_decay, max_staleness=stale_ms
+            )
+
+        def weight_rows(stack, w):
+            """Flat-path staleness application: rows scaled after the
+            attack, before subsets/aggregation — per-row weights commute
+            with row selection, so each observer's subset sees exactly
+            its members' discounts (the host-plane order)."""
+            if w is None:
+                return stack
+            return (stack * w[:, None]).astype(stack.dtype)
+
         def node_subset_keys(key):
             """Per-node (sel, gar_key) for one exchange — the SAME key
             derivation as the flat path's ``node_aggregate`` (keyed by the
@@ -315,7 +406,7 @@ def make_trainer(
             return jnp.broadcast_to(one[None], (per_n,) + one.shape)
 
         def tree_exchange(stacked_tree, plan, akey, key, attack_name,
-                          attack_kw, center_tree=None):
+                          attack_kw, center_tree=None, row_weights=None):
             """One exchange on the stacked TREE: folded deterministic
             attacks poison the Gram (never the rows); randomized attacks
             take the tree where-path first; per-node subsets compose onto
@@ -323,7 +414,9 @@ def make_trainer(
             leading per_n axis. ``center_tree``: per-node carried centers
             (leading per_n axis) for stateful rules — consumed on the
             full-participation route only (the subset route is Gram-form,
-            stateless)."""
+            stateless). ``row_weights``: the bounded-staleness discount,
+            composed into the Gram row-scale algebra (the tree route is
+            gated to gram_select rules when weights are active)."""
             if plan is None and attack_name not in (None, "none"):
                 stacked_tree = apply_gradient_attack_tree(
                     attack_name, stacked_tree, byz_mask, key=akey,
@@ -334,6 +427,24 @@ def make_trainer(
                 return fold.folded_tree_aggregate_multi(
                     gar, plan, stacked_tree, f=f, keys=gkeys,
                     gar_params=gar_params, subset_sels=sels,
+                    row_weights=row_weights,
+                )
+            if row_weights is not None:
+                # Weighted full participation: one observer view through
+                # the multi form (it accepts plan None AND composes the
+                # weights into the Gram; with neither subsets nor keys it
+                # returns the single selection WITHOUT a leading axis),
+                # broadcast to the local slots — gram_select rules are
+                # stateless, so center_tree never reaches this route.
+                one = fold.folded_tree_aggregate_multi(
+                    gar, plan, stacked_tree, f=f,
+                    gar_params=gar_params, row_weights=row_weights,
+                )
+                return jax.tree.map(
+                    lambda l: jnp.broadcast_to(
+                        l[None], (per_n,) + l.shape
+                    ),
+                    one,
                 )
             center_kw = {}
             if center_tree is not None:
@@ -422,16 +533,20 @@ def make_trainer(
             lambda l: jax.lax.all_gather(l, axis, tiled=True), grads_local
         )
 
+        stale_w2 = stale_w_for(0)
+
         def phase2(centers_tree, centers_rows):
             if grad_tree_ok:
                 return tree_exchange(
                     gathered, fold_plan, atk_key, sub_key, attack,
                     attack_params, center_tree=centers_tree,
+                    row_weights=stale_w2,
                 )
             stack0 = core.flatten_rows(gathered)  # (n, d)
             stack0 = apply_gradient_attack(
                 attack, stack0, byz_mask, key=atk_key, **attack_params
             )
+            stack0 = weight_rows(stack0, stale_w2)
             return local_aggregates(stack0, sub_key, centers=centers_rows)
 
         if gar.stateful_center:
@@ -460,6 +575,9 @@ def make_trainer(
                 attack, core.flatten_rows(gathered), byz_mask, key=atk_key,
                 **attack_params,
             )
+            # The tap audits the rows the rule consumed — staleness-
+            # weighted included (the aggregathor tap convention).
+            stack0p = weight_rows(stack0p, stale_w2)
             if waiting:
                 def one_tap(nid):
                     # SAME (sel, key) derivation as node_aggregate /
@@ -514,6 +632,7 @@ def make_trainer(
                     new = tree_exchange(
                         served, fold_plan, akey, skey, attack, attack_params,
                         center_tree=aggr if gar.stateful_center else None,
+                        row_weights=stale_w_for(1 + r),
                     )
                     return jax.tree.map(
                         lambda a, b: jnp.where(r < rounds, a, b), new, aggr
@@ -527,6 +646,7 @@ def make_trainer(
                     served = apply_gradient_attack(
                         attack, served, byz_mask, key=akey, **attack_params
                     )
+                    served = weight_rows(served, stale_w_for(1 + r))
                     new = local_aggregates(
                         served, skey,
                         centers=aggr if gar.stateful_center else None,
@@ -574,6 +694,7 @@ def make_trainer(
         # gradient plane; stateful rules center each node's clip on its OWN
         # model (the ClippedGossip recipe) instead of a per-call median.
         if model_gossip:
+            stale_wg = stale_w_for(0x5009)
             if gossip_tree_ok:
                 models_tree = jax.tree.map(
                     lambda l: jax.lax.all_gather(l, axis, tiled=True),
@@ -583,6 +704,7 @@ def make_trainer(
                     models_tree, model_fold_plan, matk_key, msub_key,
                     None, {},
                     center_tree=new_params if gar.stateful_center else None,
+                    row_weights=stale_wg,
                 )
             else:
                 flat_models = core.flatten_rows(new_params)  # (per_n, d)
@@ -591,6 +713,12 @@ def make_trainer(
                     model_attack, models, byz_mask, key=matk_key,
                     **model_attack_params,
                 )
+                # Gossip-plane staleness: a stale model's row is
+                # discounted like a stale gradient's — the robust rule
+                # then treats the down-scaled row as the outlier it is,
+                # and the fresh honest majority keeps its influence
+                # (DESIGN.md §15; the same composition as the PS plane).
+                models = weight_rows(models, stale_wg)
                 aggr_models = local_aggregates(
                     models, msub_key,
                     centers=flat_models if gar.stateful_center else None,

@@ -52,9 +52,20 @@ SCHEMA = "garfield-telemetry"
 # optional ``spans`` count + per-phase ``phases`` digest, and
 # ``exchange_bench`` rows may carry per-phase ``phases`` percentiles
 # plus the tracing A/B fields (``trace_off_round_s``,
-# ``trace_on_round_s``, ``trace_overhead``). Older records still
-# validate — consumers key on field presence, not version.
-SCHEMA_VERSION = 5
+# ``trace_on_round_s``, ``trace_overhead``). v6 (round 13, elastic
+# asynchrony — DESIGN.md §15): exchange events are PLANE-TAGGED
+# (``exchange_wait``/``staleness`` may carry ``plane``; per-step
+# ``wire`` events may carry a per-plane byte breakdown under
+# ``planes``), the new ``autoscale`` EVENT (action/rank/active/rate/
+# target — validated below) with its ``summary.autoscale`` digest
+# (spawns/retires/active_workers) and the ``garfield_active_workers``
+# Prometheus gauge, and ``exchange_bench`` rows may carry the
+# scaleup/scaledown scenario fields (``pre_rate``, ``spike_rate``,
+# ``recovered_rate``, ``active_initial``, ``active_final``,
+# ``spawns``, ``retires``) plus the LEARN-scenario fields
+# (``learn_ms0_bitwise``). Older records still validate — consumers
+# key on field presence, not version.
+SCHEMA_VERSION = 6
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
          "transfer_bench", "exchange_bench", "hier_bench", "span")
@@ -169,6 +180,28 @@ def validate_record(rec):
                     f"staleness.step must be a non-negative int, "
                     f"got {step!r}"
                 )
+        elif rec.get("event") == "autoscale":
+            # v6: one elastic-membership action (DESIGN.md §15).
+            if rec.get("action") not in ("spawn", "retire"):
+                _fail(
+                    f"autoscale.action must be 'spawn' or 'retire', "
+                    f"got {rec.get('action')!r}"
+                )
+            for key in ("rank", "active"):
+                val = rec.get(key)
+                if not isinstance(val, int) or isinstance(val, bool) \
+                        or val < 0:
+                    _fail(
+                        f"autoscale.{key} must be a non-negative int, "
+                        f"got {val!r}"
+                    )
+            for key in ("rate", "target"):
+                val = rec.get(key)
+                if val is not None and not _is_num(val):
+                    _fail(
+                        f"autoscale.{key} must be a number or null, "
+                        f"got {val!r}"
+                    )
     elif kind == "span":
         # v5: one timed phase of a round (telemetry/trace.py).
         if not isinstance(rec.get("phase"), str) or not rec["phase"]:
@@ -246,6 +279,19 @@ def validate_record(rec):
                     f"summary.staleness.hist must map staleness to "
                     f"counts, got {hist!r}"
                 )
+        asd = rec.get("autoscale")
+        if asd is not None:
+            # v6: the elastic-membership digest (hub.autoscale_stats).
+            if not isinstance(asd, dict):
+                _fail(f"summary.autoscale must be an object, got {asd!r}")
+            for key in ("spawns", "retires", "active_workers"):
+                val = asd.get(key)
+                if not isinstance(val, int) or isinstance(val, bool) \
+                        or val < 0:
+                    _fail(
+                        f"summary.autoscale.{key} must be a non-negative "
+                        f"int, got {val!r}"
+                    )
     elif kind == "bench":
         if not isinstance(rec.get("metric"), str):
             _fail(f"bench.metric must be a string, got {rec.get('metric')!r}")
@@ -325,13 +371,33 @@ def validate_record(rec):
         for key in ("round_s", "wire_bytes_per_step", "straggler_ms",
                     "sync_round_s", "async_round_s", "speedup",
                     "trace_off_round_s", "trace_on_round_s",
-                    "trace_overhead"):
+                    "trace_overhead",
+                    # v6: autoscale scenario rates (scaleup/scaledown).
+                    "pre_rate", "spike_rate", "recovered_rate"):
             val = rec.get(key)
             if val is not None and not _is_num(val):
                 _fail(
                     f"exchange_bench.{key} must be a number or null, "
                     f"got {val!r}"
                 )
+        for key in ("active_initial", "active_final", "spawns",
+                    "retires"):
+            # v6: membership counts — integers, not rates.
+            val = rec.get(key)
+            if val is not None and (
+                not isinstance(val, int) or isinstance(val, bool)
+                or val < 0
+            ):
+                _fail(
+                    f"exchange_bench.{key} must be a non-negative int "
+                    f"or null, got {val!r}"
+                )
+        lb = rec.get("learn_ms0_bitwise")
+        if lb is not None and not isinstance(lb, bool):
+            _fail(
+                f"exchange_bench.learn_ms0_bitwise must be a bool or "
+                f"null, got {lb!r}"
+            )
         rss = rec.get("peak_rss_bytes")
         if rss is not None and (
             not isinstance(rss, int) or isinstance(rss, bool) or rss < 0
@@ -448,6 +514,18 @@ def prometheus_text(hub):
                "Wire bytes through the typed host-plane codec.",
                [({"direction": "out"}, float(w["bytes_out"])),
                 ({"direction": "in"}, float(w["bytes_in"]))])
+        planes = hub.wire_plane_counters()
+        if planes:
+            # v6: plane-labelled byte counters (DESIGN.md §15) — the
+            # gradient/model/control planes' wire costs attribute
+            # separately instead of blurring into the totals.
+            metric("garfield_wire_plane_bytes_total", "counter",
+                   "Wire bytes per exchange plane (0=control, "
+                   "1=gradients, 2=models).",
+                   [({"plane": p, "direction": d},
+                     float(counts["bytes_" + d]))
+                    for p, counts in planes.items()
+                    for d in ("out", "in")])
         metric("garfield_wire_codec_seconds_total", "counter",
                "Host seconds spent in the wire codec.",
                [({"op": "encode"}, w["encode_s"]),
@@ -487,6 +565,16 @@ def prometheus_text(hub):
         metric("garfield_staleness_rounds_max", "gauge",
                "Largest staleness admitted so far (bounded by "
                "--max_staleness).", [({}, float(stale["max"]))])
+    autos = hub.autoscale_stats()
+    if autos is not None:
+        # v6: the elastic-membership plane (DESIGN.md §15).
+        metric("garfield_active_workers", "gauge",
+               "Workers currently active under the autoscale controller.",
+               [({}, float(autos["active_workers"]))])
+        metric("garfield_autoscale_actions_total", "counter",
+               "Autoscale membership actions taken.",
+               [({"action": "spawn"}, float(autos["spawns"])),
+                ({"action": "retire"}, float(autos["retires"]))])
     susp = hub.suspicion()
     if susp is not None:
         metric("garfield_rank_suspicion", "gauge",

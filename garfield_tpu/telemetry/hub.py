@@ -65,11 +65,19 @@ class MetricsHub:
         self._selected_hist = collections.deque(maxlen=120)
         # Wire-plane accounting (DESIGN.md §11): folded from the cluster
         # roles' per-step "wire" events and the exchange's publisher-side
-        # "send_queue_drop" events, exposed by both exporters.
+        # "send_queue_drop" events, exposed by both exporters. Schema v6
+        # adds the per-PLANE byte breakdown (wire events' ``planes``
+        # sub-object) behind the plane-labelled Prometheus counters.
         self._wire = {
             "bytes_out": 0, "bytes_in": 0, "frames_in": 0,
             "encode_s": 0.0, "decode_s": 0.0, "send_queue_drops": 0,
         }
+        self._wire_planes = {}  # plane -> {"bytes_out": n, "bytes_in": n}
+        # Elastic-membership accounting (schema v6, DESIGN.md §15):
+        # folded from the PS autoscaler's "autoscale" events — running
+        # active-worker count (the garfield_active_workers gauge) and
+        # spawn/retire totals for the run summary.
+        self._autoscale = {"spawns": 0, "retires": 0, "active": None}
         # Bounded-staleness accounting (schema v4, DESIGN.md §14): the
         # async PS emits one "staleness" event per round with the
         # quorum's per-rank staleness + discount weights; folded into a
@@ -160,8 +168,22 @@ class MetricsHub:
                     self._wire[key] += int(fields.get(key, 0) or 0)
                 for key in ("encode_s", "decode_s"):
                     self._wire[key] += float(fields.get(key, 0.0) or 0.0)
+                for p, d in (fields.get("planes") or {}).items():
+                    acc = self._wire_planes.setdefault(
+                        str(p), {"bytes_out": 0, "bytes_in": 0}
+                    )
+                    acc["bytes_out"] += int(d.get("bytes_out", 0) or 0)
+                    acc["bytes_in"] += int(d.get("bytes_in", 0) or 0)
             elif kind == "send_queue_drop":
                 self._wire["send_queue_drops"] += 1
+            elif kind == "autoscale":
+                a = self._autoscale
+                if fields.get("action") == "spawn":
+                    a["spawns"] += 1
+                elif fields.get("action") == "retire":
+                    a["retires"] += 1
+                if fields.get("active") is not None:
+                    a["active"] = int(fields["active"])
             elif kind == "staleness":
                 # Per-round async-quorum audit (apps/cluster.py): fold
                 # the discount deficit (1 - w) into the same exclusion-
@@ -284,6 +306,32 @@ class MetricsHub:
         with self._lock:
             return dict(self._wire)
 
+    def wire_plane_counters(self):
+        """Per-plane wire byte totals ({plane: {bytes_out, bytes_in}}),
+        or {} when no plane-tagged wire event was folded (schema v6)."""
+        with self._lock:
+            return {p: dict(d) for p, d in sorted(
+                self._wire_planes.items()
+            )}
+
+    def autoscale_stats(self):
+        """spawns/retires/active_workers over the run, or None when no
+        autoscale event was folded (fixed-membership runs)."""
+        with self._lock:
+            a = self._autoscale
+            if not a["spawns"] and not a["retires"] and a["active"] is None:
+                return None
+            return {
+                "spawns": int(a["spawns"]),
+                "retires": int(a["retires"]),
+                "active_workers": int(a["active"] or 0),
+            }
+
+    def active_workers(self):
+        """Current active-worker count (last autoscale event), or None."""
+        with self._lock:
+            return self._autoscale["active"]
+
     def staleness_stats(self):
         """count/mean/max + rounds histogram over every quorum member of
         every async round, or None when no staleness event was folded
@@ -374,6 +422,8 @@ class MetricsHub:
         """The run-closing JSONL record: suspicion, counters, timings."""
         susp = self.suspicion()
         stale = self.staleness_stats()
+        autos = self.autoscale_stats()
+        wire_planes = self.wire_plane_counters()
         phases = self.phase_stats()
         if phases is not None:
             phases = {
@@ -424,9 +474,15 @@ class MetricsHub:
                     else {k: (round(v, 6) if isinstance(v, float) else v)
                           for k, v in self._wire.items()}
                 ),
+                # schema v6: per-plane wire byte breakdown (None when no
+                # plane-tagged wire event was folded).
+                wire_planes=wire_planes or None,
                 # schema v4: the async plane's staleness digest (None on
                 # synchronous runs — v3 consumers are unaffected).
                 staleness=stale,
+                # schema v6: elastic-membership digest (None on
+                # fixed-membership runs).
+                autoscale=autos,
                 meta=self.meta,
             )
 

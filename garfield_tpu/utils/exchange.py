@@ -26,6 +26,18 @@ Reference counterparts re-designed here:
     history implements with lists and sleeps.
 
 Wire format per frame: ``!IQQ`` header (peer_id, step, nbytes) + payload.
+The peer-id field's high byte is the **plane tag** (DESIGN.md §15): an
+exchange built with ``planes=P`` carries P independent register slots per
+peer (one ``MultiBuffer`` slot per (peer, plane)), so protocols that used
+to multiplex several logical planes through one last-writer-wins slot —
+LEARN's gossip interleaved gradients and models as steps 2i+2/2i+3 —
+instead publish each plane to its own slot and a slow consumer of one
+plane can no longer lose frames to the other's overwrites. Plane 0 is
+the default everywhere, so single-plane deployments (and their committed
+trajectories) are untouched; the typed payloads of ``utils.wire`` carry
+the same plane tag in their codec header's spare bits, making the frames
+self-describing end to end.
+
 Slot payloads are stored as ``!Q`` step + payload so ``collect`` only
 accepts the exact step it asked for — the register is last-writer-wins, so
 a publisher racing ahead overwrites older frames and a reader that missed
@@ -34,6 +46,7 @@ step before peers publish the next (the bulk-synchronous round structure
 every topology here has).
 """
 
+import functools
 import queue
 import socket
 import struct
@@ -47,21 +60,28 @@ __all__ = ["PeerExchange", "RoundCollector"]
 
 _HDR = struct.Struct("!IQQ")
 _SLOT = struct.Struct("!Q")
+# Plane tag in the transport header: high byte of the u32 peer-id field
+# (peer counts are tiny; 2^24 ranks is far beyond any deployment).
+_PLANE_SHIFT = 24
+_PEER_MASK = (1 << _PLANE_SHIFT) - 1
 
 
-def _emit_wait(step, q, arrived, wait_s, timed_out=False):
+def _emit_wait(step, q, arrived, wait_s, timed_out=False, plane=0):
     """Report one wait-n-f quorum wait to the telemetry plane.
 
     Goes through the process-global hook (telemetry.hub.emit_event), a
     no-op when no MetricsHub is installed — un-telemetered deployments
     pay one cached-import dict lookup per collect. These events are the
     host-side latency ground truth the on-mesh seeded-subset emulation
-    has no access to (docs/TELEMETRY.md)."""
+    has no access to (docs/TELEMETRY.md). ``plane`` tags which exchange
+    plane the wait served (schema v6) so multi-plane protocols' latencies
+    attribute per plane instead of blurring together."""
     from ..telemetry import hub as _tele_hub
 
     _tele_hub.emit_event(
         "exchange_wait", step=int(step), q=int(q), arrived=int(arrived),
         wait_s=round(float(wait_s), 6), timed_out=bool(timed_out),
+        plane=int(plane),
     )
 
 
@@ -105,15 +125,20 @@ class PeerExchange:
 
     def __init__(self, my_index, hosts, *, accept_timeout_ms=100,
                  connect_retry_ms=10_000, reconnect_timeout_ms=1_000,
-                 send_timeout_ms=5_000, send_queue_frames=4):
+                 send_timeout_ms=5_000, send_queue_frames=4, planes=1):
         self.my_index = int(my_index)
         self.hosts = list(hosts)
         self.n = len(self.hosts)
+        self.planes = int(planes)
+        if not 1 <= self.planes <= 16:
+            raise ValueError(f"planes must be in [1, 16], got {planes}")
         self.connect_retry_ms = connect_retry_ms
         self.reconnect_timeout_ms = reconnect_timeout_ms
         self.send_timeout_ms = send_timeout_ms
         self.send_queue_frames = send_queue_frames
-        self._mb = MultiBuffer(self.n)
+        # One register slot per (peer, plane): plane p's slots occupy
+        # [p*n, (p+1)*n) — see _slot. Plane 0 is the classic layout.
+        self._mb = MultiBuffer(self.n * self.planes)
         self._send_socks = {}
         self._connect_attempted = set()  # peers whose startup grace is spent
         self._send_lock = threading.Lock()
@@ -123,6 +148,14 @@ class PeerExchange:
         self._conns = []         # inbound connections, closed at close
         self._peer_threads = []  # inbound reader threads (they mb.write)
         self._conns_lock = threading.Lock()
+        # Per-peer watcher registry (the symmetric-teardown contract of
+        # remove_peer): every live registration watching peer idx's slots
+        # — collect_begin waiters, read_latest_begin latches AND
+        # RoundCollector watchers — records (cancel_callable, thread)
+        # here so a churn leave / Byzantine ban retires them ALL at once.
+        # Dead threads are pruned lazily on registration and removal.
+        self._peer_watchers = {}
+        self._watchers_lock = threading.Lock()
 
         ip, _, port = self.hosts[self.my_index].rpartition(":")
         self._server = socket.create_server(
@@ -152,16 +185,25 @@ class PeerExchange:
                 self._peer_threads.append(t)
             t.start()
 
+    def _slot(self, idx, plane=0):
+        """Register slot of (peer ``idx``, ``plane``)."""
+        return plane * self.n + idx
+
     def _peer_loop(self, conn):
         try:
             while not self._closing.is_set():
-                peer_id, step, nbytes = _HDR.unpack(
+                tagged, step, nbytes = _HDR.unpack(
                     _recv_exact(conn, _HDR.size)
                 )
                 payload = _recv_exact(conn, nbytes)
-                if 0 <= peer_id < self.n:
+                peer_id = tagged & _PEER_MASK
+                plane = tagged >> _PLANE_SHIFT
+                # A plane this exchange was not built with is dropped like
+                # an out-of-range peer id: mixed-plane deployments must
+                # not corrupt a foreign slot.
+                if 0 <= peer_id < self.n and plane < self.planes:
                     self._mb.write(
-                        peer_id, _SLOT.pack(step) + payload
+                        self._slot(peer_id, plane), _SLOT.pack(step) + payload
                     )
         except (ConnectionError, OSError):
             pass  # peer gone: its slot simply stops advancing
@@ -170,6 +212,41 @@ class PeerExchange:
                 conn.close()
             except OSError:
                 pass
+
+    # --- per-peer watcher registry (symmetric teardown) --------------------
+
+    def _register_watcher(self, idx, cancel, thread):
+        """Record a live registration watching peer ``idx``'s slots so
+        ``remove_peer`` can retire it; prunes finished entries."""
+        with self._watchers_lock:
+            entries = self._peer_watchers.setdefault(int(idx), [])
+            entries[:] = [e for e in entries if e[1].is_alive()]
+            entries.append((cancel, thread))
+
+    def remove_peer(self, idx):
+        """Retire EVERY live watcher on peer ``idx``'s slots — collect
+        waiters, ``read_latest_begin`` latches and ``RoundCollector``
+        watchers alike — the churn-leave / Byzantine-ban teardown.
+
+        Before this existed the teardown was ASYMMETRIC: a membership
+        change cancelled the round collector's watcher for the departed
+        peer, but any ``read_latest_begin`` latch registered on the same
+        peer kept its thread (and its eager-decode transform) alive until
+        the harvest deadline or ``close()`` — a slow leak on every churn
+        leave, pinned by tests/test_exchange.py. Cancellation here is
+        idempotent and joins each watcher briefly so the caller observes
+        the threads actually gone.
+        """
+        with self._watchers_lock:
+            entries = self._peer_watchers.pop(int(idx), [])
+        for cancel, t in entries:
+            try:
+                cancel()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+        for _, t in entries:
+            if t is not threading.current_thread():
+                t.join(timeout=5)
 
     # --- send side ---------------------------------------------------------
 
@@ -254,9 +331,11 @@ class PeerExchange:
             s = self._senders[idx] = (q, t)
         return s
 
-    def publish(self, step, payload, *, to=None):
+    def publish(self, step, payload, *, to=None, plane=0):
         """Send (step, payload) to every peer (or just ``to``); deposit
-        locally too.
+        locally too. ``plane`` routes the frame to that plane's register
+        slots on every receiver (DESIGN.md §15) — plane 0 is the classic
+        single-plane layout.
 
         Sends go through PER-PEER sender threads with bounded FIFO queues
         (VERDICT r3 weak #4): one hung — not crashed — receiver used to
@@ -272,13 +351,23 @@ class PeerExchange:
         RPC pulls.
         """
         payload = bytes(payload)
+        plane = int(plane)
+        if not 0 <= plane < self.planes:
+            raise ValueError(
+                f"plane {plane} out of range for a {self.planes}-plane "
+                "exchange"
+            )
         targets = range(self.n) if to is None else to
         with _trace.span(
-            "publish", step=int(step), nbytes=len(payload),
+            "publish", step=int(step), nbytes=len(payload), plane=plane,
             fanout=len(targets) if to is not None else self.n - 1,
         ):
-            self._mb.write(self.my_index, _SLOT.pack(step) + payload)
-            frame = _HDR.pack(self.my_index, step, len(payload)) + payload
+            self._mb.write(
+                self._slot(self.my_index, plane), _SLOT.pack(step) + payload
+            )
+            frame = _HDR.pack(
+                self.my_index | (plane << _PLANE_SHIFT), step, len(payload)
+            ) + payload
             for idx in targets:
                 if idx == self.my_index:
                     continue
@@ -302,7 +391,7 @@ class PeerExchange:
     # --- collect (wait-n-f) ------------------------------------------------
 
     def _wait_slot(self, idx, step, deadline_box, results, sem,
-                   transform=None, cancel=None):
+                   transform=None, cancel=None, plane=0):
         """Block on the native register until peer idx publishes ``step``.
 
         Only the EXACT step joins the quorum: the register is
@@ -345,7 +434,7 @@ class PeerExchange:
                         break
                 try:
                     version, raw = self._mb.read(
-                        idx, min_version=version + 1,
+                        self._slot(idx, plane), min_version=version + 1,
                         timeout_ms=min(max(chunk_ms, 1), 1_000),
                     )
                 except TimeoutError:
@@ -375,7 +464,7 @@ class PeerExchange:
             sem.release()
 
     def collect_begin(self, step, q, *, timeout_ms=30_000, peers=None,
-                      transform=None):
+                      transform=None, plane=0):
         """Register the waiters for ``step`` NOW; harvest with ``.wait()``.
 
         Symmetric all-to-all protocols (LEARN gossip) need this split: with
@@ -407,19 +496,29 @@ class PeerExchange:
         results = {}
         sem = threading.Semaphore(0)
         deadline_box = [None]  # armed by wait()
-        cancel = threading.Event()
+        # Per-PEER cancel events (not one shared event): remove_peer must
+        # retire exactly the departed peer's waiter while the rest of the
+        # registration keeps collecting. cancel_all (the harvest/teardown
+        # path) sets every one.
+        peer_cancels = {}
         # Prune finished waiters from earlier collects — without this a long
         # run retains O(steps * n) dead Thread objects until close().
         self._waiters = [t for t in self._waiters if t.is_alive()]
         for idx in peers:
+            ev = peer_cancels[idx] = threading.Event()
             t = threading.Thread(
                 target=self._wait_slot,
                 args=(idx, step, deadline_box, results, sem, transform,
-                      cancel),
+                      ev, plane),
                 daemon=True,
             )
             self._waiters.append(t)
             t.start()
+            self._register_watcher(idx, ev.set, t)
+
+        def cancel_all():
+            for ev in peer_cancels.values():
+                ev.set()
 
         def wait():
             # Every waiter releases exactly once (success, give-up, or
@@ -430,7 +529,9 @@ class PeerExchange:
             t0 = time.monotonic()
             deadline_box[0] = t0 + timeout_ms / 1000.0
             hard = deadline_box[0] + 2.0
-            sp = _trace.span("collect", step=int(step), q=int(q))
+            sp = _trace.span(
+                "collect", step=int(step), q=int(q), plane=int(plane)
+            )
             try:
                 with sp:
                     for _ in range(len(peers)):
@@ -441,19 +542,21 @@ class PeerExchange:
                         if len(results) >= q:
                             sp.set(arrived=len(results))
                             _emit_wait(
-                                step, q, len(results), time.monotonic() - t0
+                                step, q, len(results),
+                                time.monotonic() - t0, plane=plane,
                             )
                             return dict(results)
                     if len(results) >= q:
                         sp.set(arrived=len(results))
                         _emit_wait(
-                            step, q, len(results), time.monotonic() - t0
+                            step, q, len(results), time.monotonic() - t0,
+                            plane=plane,
                         )
                         return dict(results)
                     sp.set(arrived=len(results), timed_out=True)
                     _emit_wait(
                         step, q, len(results), time.monotonic() - t0,
-                        timed_out=True,
+                        timed_out=True, plane=plane,
                     )
                     raise TimeoutError(
                         f"only {len(results)}/{q} peers reached step {step} "
@@ -463,13 +566,13 @@ class PeerExchange:
                 # Single-harvest contract: whatever waiters are still
                 # blocked (beyond-quorum slots, give-ups in flight) are
                 # released now instead of at their deadline.
-                cancel.set()
+                cancel_all()
 
-        wait.cancel = cancel.set
+        wait.cancel = cancel_all
         return wait
 
     def collect(self, step, q, *, timeout_ms=30_000, peers=None,
-                transform=None):
+                transform=None, plane=0):
         """Payloads of the q fastest peers (self included) at ``step``.
 
         Returns a dict {peer_index: payload} with >= q entries, or raises
@@ -484,10 +587,11 @@ class PeerExchange:
         per-frame decode hook (see ``_wait_slot``).
         """
         return self.collect_begin(
-            step, q, timeout_ms=timeout_ms, peers=peers, transform=transform
+            step, q, timeout_ms=timeout_ms, peers=peers, transform=transform,
+            plane=plane,
         )()
 
-    def read_latest_begin(self, idx, min_step, *, transform=None):
+    def read_latest_begin(self, idx, min_step, *, transform=None, plane=0):
         """Register a watcher on peer ``idx``'s slot NOW; harvest the
         newest (step, payload) with step >= ``min_step`` via the returned
         ``wait(timeout_ms)``.
@@ -517,7 +621,8 @@ class PeerExchange:
             while not (self._closing.is_set() or harvested.is_set()):
                 try:
                     version, raw = self._mb.read(
-                        idx, min_version=version + 1, timeout_ms=500
+                        self._slot(idx, plane), min_version=version + 1,
+                        timeout_ms=500,
                     )
                 except TimeoutError:
                     continue
@@ -543,6 +648,9 @@ class PeerExchange:
         self._waiters = [w for w in self._waiters if w.is_alive()]
         self._waiters.append(t)
         t.start()
+        # Symmetric teardown (remove_peer docstring): the latch is a peer
+        # watcher like any collect waiter — a churn leave retires it too.
+        self._register_watcher(idx, harvested.set, t)
 
         def wait(timeout_ms=30_000):
             deadline = time.monotonic() + timeout_ms / 1000.0
@@ -570,12 +678,14 @@ class PeerExchange:
         wait.cancel = harvested.set
         return wait
 
-    def round_collector(self, peers, *, transform=None):
-        """A ``RoundCollector`` over this exchange's ``peers`` slots — the
-        bounded-staleness quorum primitive (see the class docstring)."""
-        return RoundCollector(self, peers, transform=transform)
+    def round_collector(self, peers, *, transform=None, plane=0):
+        """A ``RoundCollector`` over this exchange's ``peers`` slots on
+        ``plane`` — the bounded-staleness quorum primitive (see the class
+        docstring). A multi-plane protocol builds one collector per plane
+        (LEARN async: gradients and gossip each get their own)."""
+        return RoundCollector(self, peers, transform=transform, plane=plane)
 
-    def read_latest(self, idx, min_step, *, timeout_ms=30_000):
+    def read_latest(self, idx, min_step, *, timeout_ms=30_000, plane=0):
         """Newest (step, payload) in peer ``idx``'s slot with step >=
         ``min_step``.
 
@@ -595,7 +705,8 @@ class PeerExchange:
                 break
             try:
                 version, raw = self._mb.read(
-                    idx, min_version=version + 1, timeout_ms=remaining_ms
+                    self._slot(idx, plane), min_version=version + 1,
+                    timeout_ms=remaining_ms,
                 )
             except TimeoutError:
                 break
@@ -651,7 +762,7 @@ class PeerExchange:
                 except OSError:
                     pass
             self._send_socks.clear()
-        for slot in range(self.n):
+        for slot in range(self.n * self.planes):
             self._mb.write(slot, _SLOT.pack(_CLOSE_STEP))
         for t in self._waiters:
             t.join(timeout=5)
@@ -702,11 +813,23 @@ class RoundCollector:
     At ``max_staleness=0`` a gather admits exact-round frames only — the
     synchronous wait-n-f contract — which is the host-plane half of the
     ``--max_staleness 0`` bitwise-equality guarantee.
+
+    ``plane`` scopes the collector to one exchange plane (DESIGN.md §15):
+    a protocol with several logical planes (LEARN async gossips gradients
+    AND models) runs one collector per plane over the same peers, each
+    watching its own register slots — the per-plane form of the old
+    single-slot multiplexing this class could not serve.
     """
 
-    def __init__(self, exchange, peers, *, transform=None):
+    def __init__(self, exchange, peers, *, transform=None, plane=0):
         self._ex = exchange
         self._transform = transform
+        self._plane = int(plane)
+        if not 0 <= self._plane < exchange.planes:
+            raise ValueError(
+                f"plane {plane} out of range for a {exchange.planes}-plane "
+                "exchange"
+            )
         self._cond = threading.Condition()
         self._frames = {}   # peer -> (step, payload, generation)
         self._gen = 0       # global arrival counter
@@ -719,6 +842,19 @@ class RoundCollector:
     def peers(self):
         with self._cond:
             return sorted(self._threads)
+
+    def newest(self):
+        """Newest round tag across every cached frame, or None before
+        any arrival — the SWARM CLOCK a lagging decentralized node reads
+        to catch up (the gossip analog of the SSMW worker's read_latest
+        jump): a node whose own round counter falls behind the swarm's
+        newest tag by more than the staleness cutoff would become
+        inadmissible to every peer, so it jumps instead of computing
+        rounds nobody can use."""
+        with self._cond:
+            return max(
+                (s for s, _, _ in self._frames.values()), default=None
+            )
 
     def add_peer(self, idx):
         """Start (or restart) the watcher for peer ``idx`` — a JOIN in a
@@ -739,11 +875,17 @@ class RoundCollector:
         ]
         self._ex._waiters.append(t)
         t.start()
+        # Symmetric teardown: an exchange-level remove_peer (churn leave)
+        # retires this watcher AND drops its cached frame, exactly like
+        # the collector's own remove_peer.
+        self._ex._register_watcher(
+            idx, functools.partial(self._drop_peer, idx), t
+        )
 
-    def remove_peer(self, idx):
-        """Cancel peer ``idx``'s watcher and drop its cached frame — a
-        LEAVE (or a Byzantine ban). The thread exits within one read
-        chunk; joined here so membership changes never leak threads."""
+    def _drop_peer(self, idx):
+        """Cancel + forget peer ``idx`` WITHOUT joining (the exchange's
+        ``remove_peer`` joins after cancelling every registered watcher);
+        returns the watcher thread, if any."""
         idx = int(idx)
         with self._cond:
             stop = self._stops.pop(idx, None)
@@ -754,16 +896,24 @@ class RoundCollector:
                 # before writing, so a removed peer's frame cannot be
                 # resurrected by an in-flight arrival.
                 stop.set()
+        return t
+
+    def remove_peer(self, idx):
+        """Cancel peer ``idx``'s watcher and drop its cached frame — a
+        LEAVE (or a Byzantine ban). The thread exits within one read
+        chunk; joined here so membership changes never leak threads."""
+        t = self._drop_peer(idx)
         if t is not None:
             t.join(timeout=5)
 
     def _watch(self, idx, stop):
         version = 0
         ex = self._ex
+        slot = ex._slot(idx, self._plane)
         while not (stop.is_set() or ex._closing.is_set()):
             try:
                 version, raw = ex._mb.read(
-                    idx, min_version=version + 1, timeout_ms=200
+                    slot, min_version=version + 1, timeout_ms=200
                 )
             except TimeoutError:
                 continue
@@ -805,7 +955,7 @@ class RoundCollector:
         lo = round_ - max_staleness
         sp = _trace.span(
             "gather", step=int(round_), q=int(q),
-            max_staleness=int(max_staleness),
+            max_staleness=int(max_staleness), plane=self._plane,
         )
         with sp, self._cond:
             while True:
@@ -823,7 +973,8 @@ class RoundCollector:
                             ),
                         )
                         _emit_wait(
-                            round_, q, len(adm), time.monotonic() - t0
+                            round_, q, len(adm), time.monotonic() - t0,
+                            plane=self._plane,
                         )
                         return {p: (s, pl) for p, (s, pl, _) in adm.items()}
                 remaining = deadline - time.monotonic()
@@ -831,7 +982,7 @@ class RoundCollector:
                     sp.set(arrived=len(adm), timed_out=True)
                     _emit_wait(
                         round_, q, len(adm), time.monotonic() - t0,
-                        timed_out=True,
+                        timed_out=True, plane=self._plane,
                     )
                     raise TimeoutError(
                         f"only {len(adm)}/{q} peers within staleness "

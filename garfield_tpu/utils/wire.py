@@ -10,9 +10,19 @@ could only be caught by a wrong-size frame. Every data frame now carries a
 
     magic   2s   b"GW"
     ver     u8   1
-    dtype   u8   0 = f32, 1 = bf16
+    dtype   u8   low nibble: 0 = f32, 1 = bf16; HIGH nibble: plane tag
     elems   u64  logical float32 element count
     crc32   u32  zlib.crc32 of the payload bytes
+
+The dtype byte's high nibble is the **plane tag** (DESIGN.md §15): only
+two of its 256 values were ever used, so the spare bits carry which
+logical exchange plane (gradient / model / control) the frame belongs to
+— the self-describing half of the per-plane register slots in
+``utils.exchange`` (the transport header routes; this tag lets any
+consumer label bytes per plane without context). Plane 0 frames are
+byte-identical to the pre-plane format, so every committed trajectory
+and artifact pins carry over; decoders reject only unknown LOW-nibble
+dtype tags, never a nonzero plane.
 
 ``GARFIELD_WIRE_DTYPE=f32|bf16`` selects the SEND width (default f32).
 bf16 halves every gradient, model and gossip frame on the DCN; the f32
@@ -51,8 +61,10 @@ __all__ = [
     "wire_dtype",
     "encode",
     "decode",
+    "frame_plane",
     "frame_nbytes",
     "HEADER_NBYTES",
+    "MAX_PLANE",
 ]
 
 _HDR = struct.Struct("!2sBBQI")
@@ -63,6 +75,8 @@ _TAG_F32 = 0
 _TAG_BF16 = 1
 WIRE_DTYPES = ("f32", "bf16")
 _ITEMSIZE = {_TAG_F32: 4, _TAG_BF16: 2}
+# Plane tag (high nibble of the dtype byte — see the module docstring).
+MAX_PLANE = 0x0F
 
 
 class WireError(ValueError):
@@ -96,14 +110,20 @@ def _bf16_to_f32(u16):
     return (u16.astype(np.uint32) << np.uint32(16)).view(np.float32)
 
 
-def encode(vec, dtype=None):
+def encode(vec, dtype=None, *, plane=0):
     """Encode a flat float32 vector as one typed frame.
 
     ``dtype`` overrides the env-configured send width. f32 payload bytes
-    are the exact ``vec.tobytes()`` of the pre-codec format.
+    are the exact ``vec.tobytes()`` of the pre-codec format. ``plane``
+    (0..15) stamps the header's spare high-nibble plane tag — plane 0
+    keeps the frame byte-identical to the pre-plane format.
     """
     vec = np.ascontiguousarray(np.asarray(vec).reshape(-1), np.float32)
     dtype = wire_dtype() if dtype is None else dtype
+    if not 0 <= int(plane) <= MAX_PLANE:
+        raise ValueError(
+            f"plane must be in [0, {MAX_PLANE}], got {plane}"
+        )
     if dtype == "bf16":
         payload = _f32_to_bf16(vec).tobytes()
         tag = _TAG_BF16
@@ -113,7 +133,8 @@ def encode(vec, dtype=None):
     else:
         raise ValueError(f"unknown wire dtype {dtype!r}")
     return _HDR.pack(
-        _MAGIC, _VERSION, tag, vec.size, zlib.crc32(payload)
+        _MAGIC, _VERSION, tag | (int(plane) << 4), vec.size,
+        zlib.crc32(payload),
     ) + payload
 
 
@@ -137,6 +158,7 @@ def decode(buf):
         raise WireError(f"bad magic {magic!r}")
     if ver != _VERSION:
         raise WireError(f"unsupported wire version {ver}")
+    tag &= 0x0F  # the high nibble is the plane tag (frame_plane)
     if tag not in _ITEMSIZE:
         raise WireError(f"unknown dtype tag {tag}")
     payload = buf[HEADER_NBYTES:]
@@ -150,6 +172,23 @@ def decode(buf):
     if tag == _TAG_BF16:
         return _bf16_to_f32(np.frombuffer(payload, np.uint16))
     return np.frombuffer(payload, np.float32)
+
+
+def frame_plane(buf):
+    """The plane tag of a typed frame's header (0 for pre-plane frames);
+    raises WireError on anything too short to carry a header. Reads the
+    spare high nibble only — it does NOT validate the payload (the full
+    ``decode`` does), so byte-accounting consumers can label a frame's
+    plane without paying the CRC."""
+    if len(buf) < HEADER_NBYTES:
+        raise WireError(
+            f"truncated frame: {len(buf)} bytes is shorter than the "
+            f"{HEADER_NBYTES}-byte header"
+        )
+    magic, ver, tag, _, _ = _HDR.unpack_from(buf)
+    if magic != _MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    return tag >> 4
 
 
 def frame_nbytes(elems, dtype=None):

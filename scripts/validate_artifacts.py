@@ -33,7 +33,17 @@ def find_artifacts(root=None):
     return paths
 
 
+def find_json_twins(root=None):
+    """The ``*_r*.json`` twins of the JSONL artifacts (EXCHBENCH_r04's
+    scaleup/learn rows and friends): not schema-versioned, but a twin
+    that fails to parse is the same dark-artifact failure mode."""
+    root = root or _REPO
+    return sorted(glob.glob(os.path.join(root, "*_r*.json")))
+
+
 def main(root=None, argv=None):
+    import json
+
     if argv:
         root = argv[0]
     sys.path.insert(0, root or _REPO)
@@ -50,8 +60,14 @@ def main(root=None, argv=None):
         total += count
         print(f"ok {os.path.relpath(path, root or _REPO)} "
               f"({count} records)")
+    twins = 0
+    for path in find_json_twins(root):
+        with open(path) as fp:
+            json.load(fp)  # raises on a torn/truncated capture
+        twins += 1
     print(f"validate_artifacts: {len(paths)} artifacts, "
-          f"{total} records, all schema-valid")
+          f"{total} records, all schema-valid "
+          f"(+{twins} parseable .json twins)")
     return 0
 
 

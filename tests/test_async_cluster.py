@@ -381,3 +381,193 @@ def test_async_partition_sigstop_recovers(tmp_path):
         for p in [ps, *workers]:
             if p.poll() is None:
                 p.kill()
+
+
+# --- LEARN per-plane async gossip (DESIGN.md §15) ---------------------------
+
+
+def _learn_cluster(tmp_path, n, name="learn.json"):
+    from garfield_tpu.utils import multihost
+
+    pp = _ports(n)
+    cfg_path = str(tmp_path / name)
+    multihost.generate_config(
+        cfg_path, nodes=[f"127.0.0.1:{p}" for p in pp],
+        task_type="node", task_index=0,
+    )
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO
+    env["GARFIELD_SURROGATE_MARGIN"] = "30"
+    env["GARFIELD_SURROGATE_LABEL_NOISE"] = "0"
+    env["GARFIELD_CKPT_BACKEND"] = "pickle"
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    return cfg_path, env
+
+
+def _launch_learn(k, cfg_path, env, iters, extra=()):
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "garfield_tpu.apps.learn",
+            "--cluster", cfg_path, "--task", f"node:{k}",
+            "--dataset", "pima", "--model", "pimanet", "--loss", "bce",
+            "--batch", "16", "--fw", "0", "--gar", "average",
+            "--num_iter", str(iters), "--acc_freq", "0",
+            "--cluster_timeout_ms", "120000", *extra,
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def test_learn_async_straggler_decouples_and_victim_tops_suspicion(
+    tmp_path,
+):
+    """LEARN --async over per-plane register slots: a 1.5 s/round victim
+    node must NOT set the honest nodes' pace even at fw=0 (where the
+    synchronous protocol waits on EVERYONE every round: 40 rounds would
+    cost >= 60 s in-loop) — stale-frame reuse plus the swarm catch-up
+    jump keep the honest loop an order of magnitude faster, the victim
+    finishes alongside by SKIPPING rounds, and its per-plane discount
+    deficits top every honest node's suspicion."""
+    n, n_iter = 3, 40
+    cfg_path, env = _learn_cluster(tmp_path, n)
+    tele = str(tmp_path / "tele")
+    extra = ("--async", "--max_staleness", "8", "--telemetry", tele)
+    procs = [
+        _launch_learn(
+            k, cfg_path, env, n_iter,
+            extra=extra + (
+                ("--straggler_ms", "1500") if k == n - 1 else ()
+            ),
+        )
+        for k in range(n)
+    ]
+    try:
+        summaries = []
+        for p in procs:
+            out, _ = p.communicate(timeout=400)
+            assert p.returncode == 0, f"node failed:\n{out[-2000:]}"
+            summaries.append(_summary(out))
+        for s in summaries[:-1]:  # honest nodes
+            assert s["steps"] == n_iter and s["dropped_at"] is None, s
+            # Decoupling: sync fw=0 would spend >= n_iter * 1.5 s = 60 s
+            # in-loop; the honest async wall (incl. startup) must come in
+            # far under that.
+            assert s["wall_s"] < 30, s
+        # The victim completes too — by skipping rounds, not by stalling
+        # the swarm.
+        assert summaries[-1]["skipped"] > 0, summaries[-1]
+        with open(os.path.join(
+            tele, "cluster-node-0.telemetry.jsonl"
+        )) as fp:
+            recs = [json.loads(l) for l in fp]
+        stale = [
+            r for r in recs
+            if r["kind"] == "event" and r.get("event") == "staleness"
+        ]
+        assert stale and any(e["reused"] > 0 for e in stale)
+        assert {e.get("plane") for e in stale} >= {"grad", "model"}
+        summ = [r for r in recs if r["kind"] == "summary"][-1]
+        susp = summ["suspicion"]
+        assert susp.index(max(susp)) == n - 1, susp
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_learn_async_max_staleness_zero_checkpoint_bitwise(tmp_path):
+    """--max_staleness 0 on the per-plane LEARN deployment: exact-round
+    admission, all weights exactly 1.0, the unweighted jit programs —
+    every node's final checkpoint is BYTE-equal to the synchronous
+    trajectory's."""
+    n, n_iter = 3, 12
+
+    def run(tag, async_flags):
+        cfg_path, env = _learn_cluster(tmp_path, n, name=f"{tag}.json")
+        ckpt = str(tmp_path / f"ckpt_{tag}")
+        extra = (
+            "--checkpoint_dir", ckpt, "--checkpoint_freq", str(n_iter),
+            *async_flags,
+        )
+        procs = [
+            _launch_learn(k, cfg_path, env, n_iter, extra=extra)
+            for k in range(n)
+        ]
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=400)
+                assert p.returncode == 0, f"node failed:\n{out[-2000:]}"
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        flats = []
+        for k in range(n):
+            with open(os.path.join(
+                ckpt, f"node_{k}", f"ckpt_{n_iter}.pkl"
+            ), "rb") as fp:
+                flats.append(pickle.load(fp)["flat"])
+        return flats
+
+    import numpy as np
+
+    sync = run("sync", ())
+    asyn = run("async", ("--async", "--max_staleness", "0"))
+    for k in range(n):
+        assert np.array_equal(sync[k], asyn[k]), (
+            k, float(np.abs(sync[k] - asyn[k]).max())
+        )
+
+
+def test_autoscale_ps_spawns_workers_and_completes(tmp_path):
+    """Elastic membership e2e (DESIGN.md §15): ONE launched process (the
+    PS, --autoscale) owns its worker fleet. All workers carry a 400 ms
+    sleep per gradient, so the aggregate fresh-frame rate genuinely
+    scales with the worker count even on the 1-core box; the target rate
+    is set above what the initial pair can deliver, so the controller
+    must spawn reserve ranks (launched with the PS's own CLI re-targeted
+    at worker:K) mid-run. The run completes, the summary carries the
+    schema-v6 autoscale digest, and every spawned worker is reaped."""
+    n_w, n_iter = 4, 120
+    cfg_path, env = _cluster_setup(tmp_path, n_w)
+    tele = str(tmp_path / "tele")
+    ps = _launch(
+        "ps:0", cfg_path, env,
+        extra=(
+            "--fw", "0", "--async", "--max_staleness", "8",
+            "--num_iter", str(n_iter), "--straggler_ms", "400",
+            "--autoscale", "--autoscale_min", "2", "--target_rate", "20",
+            "--autoscale_window", "6", "--autoscale_cooldown", "4",
+            "--telemetry", tele,
+        ),
+    )
+    try:
+        out, _ = ps.communicate(timeout=600)
+        assert ps.returncode == 0, f"PS failed:\n{out[-3000:]}"
+        summary = _summary(out)
+        assert summary["steps"] == n_iter
+        with open(os.path.join(
+            tele, "cluster-ps.telemetry.jsonl"
+        )) as fp:
+            recs = [json.loads(l) for l in fp]
+        summ = [r for r in recs if r["kind"] == "summary"][-1]
+        autos = summ["autoscale"]
+        assert autos is not None and autos["spawns"] >= 1, summ
+        assert autos["active_workers"] > 2, summ
+        events = [
+            r for r in recs
+            if r["kind"] == "event" and r.get("event") == "autoscale"
+        ]
+        assert events and all(
+            e["action"] in ("spawn", "retire") for e in events
+        )
+        # The PS spawned its own initial workers too: their logs landed
+        # in the telemetry dir (the _AutoscalePlane log sink).
+        logs = [f for f in os.listdir(tele) if f.startswith("worker_")]
+        assert len(logs) >= 3, logs
+    finally:
+        if ps.poll() is None:
+            ps.kill()

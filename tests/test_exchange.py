@@ -379,3 +379,114 @@ def test_collect_begin_latches_before_overwrite():
     finally:
         for p in peers:
             p.close()
+
+
+def _mesh_planes(n, planes):
+    hosts = [f"127.0.0.1:{p}" for p in _ports(n)]
+    return [PeerExchange(i, hosts, planes=planes) for i in range(n)]
+
+
+def test_per_plane_slots_do_not_overwrite_each_other():
+    """DESIGN.md §15: each (peer, plane) has its OWN register slot, so a
+    multi-plane protocol (LEARN async gossip) publishing gradients and
+    models for the same round no longer loses one plane's frame to the
+    other's last-writer-wins overwrite — the multiplexing limitation the
+    per-plane refactor removes."""
+    peers = _mesh_planes(2, 3)
+    try:
+        # Same ROUND TAG on every plane: before per-plane slots, these
+        # three publishes would overwrite one register cell.
+        peers[1].publish(5, b"grad", plane=1)
+        peers[1].publish(5, b"model", plane=2)
+        peers[1].publish(5, b"ctrl", plane=0)
+        assert peers[0].collect(
+            5, q=1, peers=[1], plane=1, timeout_ms=10_000
+        ) == {1: b"grad"}
+        assert peers[0].collect(
+            5, q=1, peers=[1], plane=2, timeout_ms=10_000
+        ) == {1: b"model"}
+        assert peers[0].collect(
+            5, q=1, peers=[1], plane=0, timeout_ms=10_000
+        ) == {1: b"ctrl"}
+        # read_latest is plane-scoped too.
+        step, payload = peers[0].read_latest(1, 5, plane=2)
+        assert (step, payload) == (5, b"model")
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_plane_out_of_range_rejected():
+    peers = _mesh_planes(2, 2)
+    try:
+        with pytest.raises(ValueError):
+            peers[0].publish(1, b"x", plane=2)
+        with pytest.raises(ValueError):
+            peers[0].round_collector([1], plane=5)
+    finally:
+        for p in peers:
+            p.close()
+    with pytest.raises(ValueError):
+        PeerExchange(0, ["127.0.0.1:1"], planes=0)
+
+
+def test_round_collectors_per_plane_independent():
+    """One collector per plane over the SAME peers: each gathers its own
+    plane's frames, and newest() reads that plane's swarm clock."""
+    peers = _mesh_planes(2, 3)
+    try:
+        cg = peers[0].round_collector([1], plane=1)
+        cm = peers[0].round_collector([1], plane=2)
+        peers[1].publish(3, b"g3", plane=1)
+        peers[1].publish(2, b"m2", plane=2)
+        got_g = cg.gather(3, 1, timeout_ms=10_000)
+        got_m = cm.gather(2, 1, timeout_ms=10_000)
+        assert got_g == {1: (3, b"g3")}
+        assert got_m == {1: (2, b"m2")}
+        assert cg.newest() == 3 and cm.newest() == 2
+        cg.close()
+        cm.close()
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_remove_peer_tears_down_all_watchers():
+    """Regression (ISSUE 9 satellite): a churn leave used to cancel the
+    round collector's watcher for the departed peer but LEAK any
+    read_latest_begin latch (and leave collect waiters to their
+    deadline). exchange.remove_peer now retires collect waiters,
+    read_latest latches AND collector watchers on that peer
+    symmetrically — and only that peer's."""
+    import time
+
+    peers = _mesh(3)
+    try:
+        ex = peers[0]
+        # One of each watcher kind on peer 1, plus controls on peer 2.
+        latch = ex.read_latest_begin(1, 99)
+        wait = ex.collect_begin(42, q=2, peers=[1, 2], timeout_ms=600_000)
+        col = ex.round_collector([1, 2])
+        time.sleep(0.3)
+        alive0 = sum(t.is_alive() for t in ex._waiters)
+        assert alive0 >= 5  # latch + 2 collect waiters + 2 col watchers
+
+        ex.remove_peer(1)
+        deadline = time.time() + 5
+        while (sum(t.is_alive() for t in ex._waiters) > 2
+               and time.time() < deadline):
+            time.sleep(0.05)
+        # Exactly peer 2's collect waiter + collector watcher survive.
+        assert sum(t.is_alive() for t in ex._waiters) == 2
+        assert col.peers() == [2]
+
+        # The collector still gathers from the survivor; the removed
+        # peer's frames cannot resurrect.
+        peers[2].publish(7, b"ok")
+        assert col.gather(7, 1, timeout_ms=10_000) == {2: (7, b"ok")}
+        wait.cancel()
+        latch.cancel()
+        col.close()
+    finally:
+        for p in peers:
+            p.close()

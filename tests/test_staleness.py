@@ -388,3 +388,225 @@ class TestTelemetryV4:
             exporters.validate_record(dict(rec, speedup="fast"))
         with pytest.raises(ValueError):
             exporters.validate_record(dict(rec, peak_rss_bytes=-1))
+
+
+class TestLearnEmulation:
+    """LEARN per-phase staleness emulation (parallel/learn ``staleness=``,
+    DESIGN.md §15): the decentralized half of the ms=0 bitwise contract
+    plus the weighted fold-vs-flat equivalence on every exchange phase
+    (phase-2 gradients, agreement rounds, model gossip)."""
+
+    def _learn(self, staleness, *, tree_path=True, gar="krum", subset=None,
+               non_iid=False, steps=4, f=2):
+        from garfield_tpu.parallel import learn
+
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = learn.make_trainer(
+            module, loss, opt, gar, num_nodes=8, f=f, attack="lie",
+            staleness=staleness, tree_path=tree_path, subset=subset,
+            non_iid=non_iid,
+        )
+        state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+        state, losses = _run(step_fn, state, x, y, steps)
+        return losses, _flat_params(state)
+
+    def test_max_staleness_zero_is_bitwise_synchronous(self):
+        l0, f0 = self._learn(None)
+        l1, f1 = self._learn({"max_staleness": 0, "decay": 0.5})
+        assert l0 == l1
+        np.testing.assert_array_equal(f0, f1)
+
+    def test_all_zero_taus_is_bitwise_synchronous(self):
+        l0, f0 = self._learn(None, gar="median", f=1)
+        l1, f1 = self._learn(
+            {"max_staleness": 3, "decay": 0.5, "taus": [0] * 8},
+            gar="median", f=1,
+        )
+        assert l0 == l1
+        np.testing.assert_array_equal(f0, f1)
+
+    def test_weighted_fold_matches_flat_per_phase(self):
+        # Subsets + agreement rounds + gossip all active: the Gram
+        # row-weight composition (folded_tree_aggregate_multi) must
+        # train like the flat path that weights rows explicitly.
+        st = {"max_staleness": 4, "decay": 0.5,
+              "taus": [0, 0, 1, 0, 2, 0, 3, 4]}
+        lt, ft = self._learn(st, tree_path=True, subset=7, non_iid=True)
+        lf, ff = self._learn(st, tree_path=False, subset=7, non_iid=True)
+        assert all(np.isfinite(v) for v in lt + lf)
+        np.testing.assert_allclose(ft, ff, rtol=2e-5, atol=1e-6)
+
+    def test_weighted_fold_matches_flat_full_participation(self):
+        st = {"max_staleness": 4, "decay": 0.5,
+              "taus": [0, 0, 1, 0, 2, 0, 3, 4]}
+        lt, ft = self._learn(st, tree_path=True)
+        lf, ff = self._learn(st, tree_path=False)
+        np.testing.assert_allclose(ft, ff, rtol=2e-5, atol=1e-6)
+
+    def test_seeded_per_phase_draws_deterministic(self):
+        a = self._learn({"max_staleness": 3, "decay": 0.7})
+        b = self._learn({"max_staleness": 3, "decay": 0.7})
+        assert a[0] == b[0]
+        np.testing.assert_array_equal(a[1], b[1])
+        assert all(np.isfinite(v) for v in a[0])
+
+    def test_bad_config_rejected(self):
+        from garfield_tpu.parallel import learn
+
+        module, loss, opt = _pima_setup()
+        with pytest.raises(ValueError, match="unknown staleness"):
+            learn.make_trainer(
+                module, loss, opt, "krum", num_nodes=8, f=2,
+                staleness={"max_stale": 3},
+            )
+        with pytest.raises(ValueError, match="shape"):
+            learn.make_trainer(
+                module, loss, opt, "krum", num_nodes=8, f=2,
+                staleness={"max_staleness": 3, "taus": [0, 1]},
+            )
+
+
+class TestMultiFoldRowWeights:
+    def test_multi_observer_weights_match_per_observer_reference(self):
+        # folded_tree_aggregate_multi(row_weights=) vs each observer's
+        # explicit weighted where-path aggregate over its subset.
+        n, f, q = 8, 2, 7
+        gar = gars["krum"]
+        byz_mask = core.default_byz_mask(n, f)
+        tree = _tiny_tree(jax.random.PRNGKey(3), n)
+        w = jnp.asarray(rounds.staleness_weights(
+            np.array([0, 1, 0, 2, 0, 0, 3, 4]), decay=0.5, max_staleness=4
+        ))
+        plan = fold.plan_for(gar, "lie", byz_mask, {})
+        sels = jnp.stack([
+            core.subset_indices(jax.random.PRNGKey(10 + m), n, q)
+            for m in range(3)
+        ])
+        got = fold.folded_tree_aggregate_multi(
+            gar, plan, tree, f=f, subset_sels=sels, row_weights=w
+        )
+        flat = core.flatten_rows(tree)
+        poisoned = apply_gradient_attack("lie", flat, byz_mask)
+        weighted = poisoned * w[:, None]
+        got_rows = core.flatten_rows(got)
+        for m in range(3):
+            ref = gar.unchecked(weighted[sels[m]], f=f)
+            np.testing.assert_allclose(
+                np.asarray(got_rows[m]), np.asarray(ref),
+                rtol=2e-5, atol=1e-6,
+            )
+
+
+class TestTelemetryV6:
+    def test_autoscale_event_validates(self):
+        from garfield_tpu.telemetry import exporters
+
+        good = exporters.make_record(
+            "event", event="autoscale", who="cluster-ps", step=4,
+            action="spawn", rank=3, active=5, rate=12.5, target=20.0,
+        )
+        exporters.validate_record(good)
+        with pytest.raises(ValueError):
+            exporters.validate_record(dict(good, action="explode"))
+        with pytest.raises(ValueError):
+            exporters.validate_record(dict(good, active=-1))
+        with pytest.raises(ValueError):
+            exporters.validate_record(dict(good, rate="fast"))
+
+    def test_hub_folds_autoscale_and_summary_validates(self):
+        from garfield_tpu.telemetry import exporters
+        from garfield_tpu.telemetry.hub import MetricsHub
+
+        hub = MetricsHub(num_ranks=4)
+        assert hub.autoscale_stats() is None
+        assert hub.active_workers() is None
+        hub.record_event("autoscale", action="spawn", rank=2, active=3)
+        hub.record_event("autoscale", action="spawn", rank=3, active=4)
+        hub.record_event("autoscale", action="retire", rank=3, active=3)
+        st = hub.autoscale_stats()
+        assert st == {"spawns": 2, "retires": 1, "active_workers": 3}
+        assert hub.active_workers() == 3
+        rec = hub.summary()
+        exporters.validate_record(rec)
+        assert rec["autoscale"] == st
+        # Fixed-membership hubs stay v5-shaped (autoscale None).
+        rec2 = MetricsHub(num_ranks=4).summary()
+        exporters.validate_record(rec2)
+        assert rec2["autoscale"] is None
+
+    def test_prometheus_active_workers_gauge(self):
+        from garfield_tpu.telemetry import exporters
+        from garfield_tpu.telemetry.hub import MetricsHub
+
+        hub = MetricsHub(num_ranks=4)
+        hub.record_event("autoscale", action="spawn", rank=1, active=2)
+        text = exporters.prometheus_text(hub)
+        assert "garfield_active_workers 2" in text
+        assert 'garfield_autoscale_actions_total{action="spawn"} 1' in text
+        assert "garfield_active_workers" not in exporters.prometheus_text(
+            MetricsHub(num_ranks=4)
+        )
+
+    def test_plane_labelled_wire_counters(self):
+        from garfield_tpu.telemetry import exporters
+        from garfield_tpu.telemetry.hub import MetricsHub
+
+        hub = MetricsHub(num_ranks=2)
+        hub.record_event(
+            "wire", who="t", step=0, bytes_out=100, bytes_in=50,
+            frames_in=2, encode_s=0.0, decode_s=0.0,
+            planes={"1": {"bytes_out": 60, "bytes_in": 50},
+                    "2": {"bytes_out": 40, "bytes_in": 0}},
+        )
+        hub.record_event(
+            "wire", who="t", step=1, bytes_out=10, bytes_in=0,
+            frames_in=0, encode_s=0.0, decode_s=0.0,
+            planes={"1": {"bytes_out": 10, "bytes_in": 0}},
+        )
+        planes = hub.wire_plane_counters()
+        assert planes["1"] == {"bytes_out": 70, "bytes_in": 50}
+        assert planes["2"] == {"bytes_out": 40, "bytes_in": 0}
+        text = exporters.prometheus_text(hub)
+        assert ('garfield_wire_plane_bytes_total'
+                '{plane="1",direction="out"} 70') in text
+        rec = hub.summary()
+        from garfield_tpu.telemetry import exporters as _e
+        _e.validate_record(rec)
+        assert rec["wire_planes"]["2"]["bytes_out"] == 40
+
+    def test_plane_tagged_exchange_wait_and_staleness_validate(self):
+        from garfield_tpu.telemetry import exporters
+
+        exporters.validate_record(exporters.make_record(
+            "event", event="exchange_wait", step=2, q=3, arrived=3,
+            wait_s=0.01, timed_out=False, plane=1,
+        ))
+        exporters.validate_record(exporters.make_record(
+            "event", event="staleness", who="cluster-node-0", step=2,
+            plane="model", ranks=[0, 1], staleness=[0, 2],
+            weights=[1.0, 0.25], reused=1,
+        ))
+
+    def test_exchange_bench_v6_rows_validate(self):
+        from garfield_tpu.telemetry import exporters
+
+        exporters.validate_record(exporters.make_record(
+            "exchange_bench", n=8, d=10000, wire="f32",
+            scenario="scaleup", pre_rate=25.0, spike_rate=6.2,
+            recovered_rate=24.0, active_initial=2, active_final=8,
+            spawns=6, retires=0, peak_rss_bytes=1,
+        ))
+        exporters.validate_record(exporters.make_record(
+            "exchange_bench", n=3, d=0, wire="f32",
+            scenario="learn_ms0", learn_ms0_bitwise=True,
+        ))
+        with pytest.raises(ValueError):
+            exporters.validate_record(exporters.make_record(
+                "exchange_bench", n=3, d=0, wire="f32",
+                learn_ms0_bitwise="yes",
+            ))
+        with pytest.raises(ValueError):
+            exporters.validate_record(exporters.make_record(
+                "exchange_bench", n=8, d=0, wire="f32", spawns=1.5,
+            ))

@@ -66,6 +66,29 @@ def test_bf16_matches_xla_convert():
     np.testing.assert_array_equal(host, xla)
 
 
+def test_plane_tag_round_trip():
+    """Schema v6: the plane tag rides the dtype byte's spare high nibble
+    — plane 0 frames are byte-identical to the pre-plane format, any
+    plane decodes to the same values, and frame_plane reads the tag
+    without paying the CRC."""
+    v = np.arange(16, dtype=np.float32)
+    for dtype in wire.WIRE_DTYPES:
+        base = wire.encode(v, dtype)
+        assert wire.encode(v, dtype, plane=0) == base  # byte-identical
+        for plane in (0, 1, 2, wire.MAX_PLANE):
+            frame = wire.encode(v, dtype, plane=plane)
+            assert wire.frame_plane(frame) == plane
+            np.testing.assert_array_equal(
+                wire.decode(frame), wire.decode(base)
+            )
+    with pytest.raises(ValueError):
+        wire.encode(v, "f32", plane=wire.MAX_PLANE + 1)
+    with pytest.raises(wire.WireError):
+        wire.frame_plane(b"short")
+    with pytest.raises(wire.WireError):
+        wire.frame_plane(b"XX" + b"\0" * 14)  # bad magic
+
+
 def test_wire_dtype_env(monkeypatch):
     monkeypatch.delenv("GARFIELD_WIRE_DTYPE", raising=False)
     assert wire.wire_dtype() == "f32"
@@ -80,13 +103,22 @@ def test_wire_dtype_env(monkeypatch):
 
 def test_fuzz_corrupted_frames_never_decode():
     """Every single-bit flip and every truncation of a valid frame must
-    raise WireError — corrupted bytes can NEVER reach a GAR. (A payload
-    flip breaks the crc; a header flip breaks magic/version/tag/length;
-    a truncation breaks the length contract.)"""
+    raise WireError — corrupted bytes can NEVER reach a GAR — EXCEPT the
+    four plane-tag bits (the dtype byte's spare high nibble, schema v6):
+    a flip there only relabels the frame's plane, and the decode must
+    return the IDENTICAL values (the payload is untouched and
+    crc-verified), so nothing corrupted can reach a GAR through that
+    nibble either. (A payload flip breaks the crc; any other header flip
+    breaks magic/version/tag/length; a truncation breaks the length
+    contract.)"""
     rng = np.random.default_rng(3)
     v = rng.standard_normal(257).astype(np.float32)
+    # dtype byte = header byte 3 ("!2sBBQI"); its high nibble is the
+    # plane tag.
+    plane_bits = {3 * 8 + b for b in (4, 5, 6, 7)}
     for dtype in wire.WIRE_DTYPES:
         frame = wire.encode(v, dtype)
+        baseline = wire.decode(frame)
         # exhaustive over the header, random over the payload
         bits = list(range(wire.HEADER_NBYTES * 8)) + list(
             rng.integers(wire.HEADER_NBYTES * 8, len(frame) * 8, 400)
@@ -94,6 +126,12 @@ def test_fuzz_corrupted_frames_never_decode():
         for bit in bits:
             ba = bytearray(frame)
             ba[bit // 8] ^= 1 << (bit % 8)
+            if bit in plane_bits:
+                np.testing.assert_array_equal(
+                    wire.decode(bytes(ba)), baseline
+                )
+                assert wire.frame_plane(bytes(ba)) != 0
+                continue
             with pytest.raises(wire.WireError):
                 wire.decode(bytes(ba))
         for cut in list(range(0, wire.HEADER_NBYTES + 2)) + list(
