@@ -1,0 +1,300 @@
+"""Closed-loop defense: suspicion-weighted GARs + rule escalation.
+
+The counterpart of ``attacks/adaptive.py`` (DESIGN.md §16). The telemetry
+plane already derives a per-rank suspicion score (exclusion frequency
+under the active rule, ``telemetry/hub.py``); this module turns that
+audit signal back into aggregation decisions, in ONE module deployed at
+both scales — the ``utils/rounds.py`` pattern:
+
+  - **Suspicion weighting** (``suspicion_weights``): a rank's rows enter
+    the GAR scaled by ``max((1 - suspicion)^power, floor)``. The law is
+    dual-backend (numpy on the host-plane PS, traced jnp for the
+    in-graph emulation's carried suspicion EMA) and EXACTLY 1.0 at
+    suspicion 0 — an all-clean history composes the unchanged stack, the
+    defense-off bitwise contract's weighted half. The weights ride the
+    SAME row-scale composition the bounded-staleness discount built
+    (``fold.folded_tree_aggregate(row_weights=)`` on Gram rules, explicit
+    row scaling elsewhere), so the folded-attack fast path survives.
+  - **Escalation** (``EscalationPolicy``): a hysteresis state machine
+    over an ordered ladder of rules — default ``krum`` (classic
+    single-select) -> ``multi-krum`` (selective averaging) -> ``bulyan``
+    (trimmed second phase, the strongest and costliest) — driven by the
+    CONCENTRATION of suspicion (``suspicion_concentration``: how much
+    the top-f ranks' suspicion exceeds the crowd's). Concentration above
+    ``theta_up`` for ``patience`` consecutive rounds escalates one
+    level; concentration below ``theta_down`` for ``clean_window``
+    consecutive rounds de-escalates. ``theta_down < theta_up`` strictly,
+    and a reading BETWEEN the thresholds resets both counters — a
+    boundary-riding adversary cannot flap the rule (pinned in
+    tests/test_defense.py).
+
+Why both: an adaptive attacker that stays just under the exclusion
+threshold (attacks/adaptive.py) is *selected*, so its suspicion stays
+low — weighting alone cannot catch it. But its probing rounds and its
+bursts ARE excluded, concentration rises, and escalation swaps in a rule
+with a lower admission threshold (Bulyan's trimmed phase bounds exactly
+the coordinate-wise excess the lie attack injects); the attacker's
+bracket then re-closes at a smaller magnitude, and the accuracy bar is
+restored (the committed DEFBENCH_r01 record).
+"""
+
+import dataclasses
+
+import numpy as np
+
+__all__ = [
+    "DEFAULT_LEVELS",
+    "LEVEL_RULES",
+    "suspicion_weights",
+    "suspicion_concentration",
+    "EscalationConfig",
+    "EscalationPolicy",
+    "DefensePlan",
+    "resolve",
+]
+
+# The escalation ladder, weakest (cheapest) first. "krum" is the classic
+# rule (select ONE best-scored gradient, m=1); "multi-krum" is this
+# repo's krum default (average the m = n - f - 2 best); "bulyan" runs
+# multi-krum phase 1 plus the coordinate-trimmed phase 2. Each level
+# resolves to a registered rule + gar_params overlay via LEVEL_RULES.
+DEFAULT_LEVELS = ("krum", "multi-krum", "bulyan")
+
+LEVEL_RULES = {
+    "krum": ("krum", {"m": 1}),
+    "multi-krum": ("krum", {}),
+    "bulyan": ("bulyan", {}),
+    "median": ("median", {}),
+    "tmean": ("tmean", {}),
+    "cclip": ("cclip", {}),
+}
+
+
+def resolve_level(level):
+    """(gar_name, gar_params) for an escalation-ladder level name."""
+    if level not in LEVEL_RULES:
+        raise ValueError(
+            f"unknown escalation level {level!r}; available: "
+            f"{sorted(LEVEL_RULES)}"
+        )
+    name, params = LEVEL_RULES[level]
+    return name, dict(params)
+
+
+def suspicion_weights(suspicion, *, power=2.0, floor=0.1, relative=True):
+    """Per-rank row weights from suspicion scores: ``max((1-s)^power,
+    floor)`` with ``s`` the (by default RELATIVE) suspicion in [0, 1].
+
+    ``relative=True`` (the default, and what the deployed defenses use)
+    measures each rank's suspicion as its EXCESS over the crowd's
+    median: ``s_rel = clip(s - median(s), 0, 1)``. Raw exclusion
+    frequency is confounded under selective rules — krum at m of n
+    refuses ``n - m`` rows EVERY round, and an adaptive cohort that
+    stays admitted pushes its own exclusions onto honest ranks, so raw
+    weighting would down-weight the victims and up-weight the attacker
+    (the inversion hazard; DESIGN.md §16). Median-relative suspicion is
+    translation-free: a uniformly-excluded crowd weighs 1.0, and only
+    ranks refused MORE than their peers lose weight.
+
+    Exactly 1.0 at relative suspicion 0 (``1.0 ** power`` is exact in
+    IEEE), so a clean or uniform history leaves the stack bitwise
+    unchanged through the row-scale composition — the same identity
+    contract as ``rounds.staleness_weights`` at tau 0. ``floor > 0``
+    keeps even a fully-suspect rank OBSERVABLE: a zero weight would
+    freeze its suspicion (nothing left to select or exclude) and hand a
+    rotation attack a permanent exit from the audit. Dual-backend like
+    the staleness law: numpy in, numpy out (host PS); jnp/tracer in,
+    jnp out (the in-graph carried-EMA emulation).
+    """
+    if not (0.0 <= floor <= 1.0):
+        raise ValueError(f"floor must be in [0, 1], got {floor}")
+    if power <= 0.0:
+        raise ValueError(f"power must be > 0, got {power}")
+    import jax
+    import jax.numpy as jnp
+
+    on_device = isinstance(suspicion, jax.Array)
+    xp = jnp if on_device else np
+    s = xp.clip(xp.asarray(suspicion, xp.float32), 0.0, 1.0)
+    if relative:
+        s = xp.clip(s - xp.median(s), 0.0, 1.0)
+    w = xp.power(xp.float32(1.0) - s, xp.float32(power))
+    return xp.maximum(w, xp.float32(floor)).astype(xp.float32)
+
+
+def suspicion_concentration(suspicion, f):
+    """How far the suspicion mass departs from uniform at the f-cohort
+    scale: ``mean(top-f suspicion) - mean(bottom-f suspicion)``, in
+    [0, 1]. ~0 for clean or uniformly noisy histories; high for BOTH
+    Byzantine signatures — a pinned victim cohort (top-f -> 1 under a
+    static attack the rule keeps refusing) AND a laundering/under-the-
+    radar cohort (bottom-f conspicuously clean while the admitted fakes
+    push the crowd's exclusions up, the adaptive-lie fingerprint). The
+    escalation trigger. Works on numpy arrays/lists and jnp arrays
+    (sort-based, no data-dependent shapes).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    on_device = isinstance(suspicion, jax.Array)
+    xp = jnp if on_device else np
+    s = xp.clip(xp.asarray(suspicion, xp.float32), 0.0, 1.0)
+    n = int(s.shape[0])
+    f = int(f)
+    if not (1 <= f < n):
+        raise ValueError(f"need 1 <= f < n, got f={f}, n={n}")
+    srt = xp.sort(s)  # ascending
+    top = xp.mean(srt[n - f:])
+    bottom = xp.mean(srt[:f])
+    return (top - bottom).astype(xp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class EscalationConfig:
+    """Hysteresis parameters of the escalation state machine."""
+
+    levels: tuple = DEFAULT_LEVELS
+    theta_up: float = 0.5
+    theta_down: float = 0.2
+    patience: int = 3
+    clean_window: int = 12
+
+    def __post_init__(self):
+        if len(self.levels) < 1:
+            raise ValueError("need at least one escalation level")
+        for lv in self.levels:
+            resolve_level(lv)  # validates
+        # A level change rebuilds the step around the SAME TrainState; a
+        # ladder mixing stateful-center rules (cclip's carried v_0) with
+        # stateless ones would change the state's structure mid-run.
+        from . import gars
+
+        stateful = {
+            gars[resolve_level(lv)[0]].stateful_center for lv in self.levels
+        }
+        if len(stateful) > 1:
+            raise ValueError(
+                f"escalation ladder {self.levels} mixes stateful-center "
+                "and stateless rules; the carried TrainState cannot "
+                "change structure at a level transition"
+            )
+        if not (0.0 <= self.theta_down < self.theta_up):
+            raise ValueError(
+                "hysteresis needs 0 <= theta_down < theta_up, got "
+                f"[{self.theta_down}, {self.theta_up}]"
+            )
+        if self.patience < 1 or self.clean_window < 1:
+            raise ValueError("patience and clean_window must be >= 1")
+
+
+class EscalationPolicy:
+    """The defense's rule ladder: escalate under concentrated suspicion,
+    de-escalate after a sustained clean window, never flap on a boundary.
+
+    Counter semantics (the hysteresis contract, pinned in
+    tests/test_defense.py): a concentration reading >= ``theta_up``
+    increments the escalate counter and zeroes the clean counter; a
+    reading <= ``theta_down`` does the reverse; a reading strictly
+    BETWEEN the thresholds zeroes BOTH — sustained evidence on one side
+    is required, so a value oscillating around either threshold (or
+    parked between them) changes nothing. Every level change resets both
+    counters: the new rule's steady state is measured, not the
+    transient that triggered it (the cooldown idea of
+    ``utils/autoscale.py``).
+    """
+
+    def __init__(self, config=None):
+        self.config = config or EscalationConfig()
+        self.level = 0
+        self._hot = 0
+        self._clean = 0
+        self.escalations = 0
+        self.deescalations = 0
+
+    @property
+    def level_name(self):
+        return self.config.levels[self.level]
+
+    def current(self):
+        """(gar_name, gar_params) of the active level."""
+        return resolve_level(self.level_name)
+
+    def observe(self, concentration):
+        """Fold one round's suspicion concentration; returns +1 on
+        escalation, -1 on de-escalation, 0 otherwise."""
+        c = float(concentration)
+        cfg = self.config
+        if c >= cfg.theta_up:
+            self._hot += 1
+            self._clean = 0
+        elif c <= cfg.theta_down:
+            self._clean += 1
+            self._hot = 0
+        else:
+            # The hysteresis band: evidence for neither transition.
+            self._hot = 0
+            self._clean = 0
+        if self._hot >= cfg.patience and self.level < len(cfg.levels) - 1:
+            self.level += 1
+            self._hot = 0
+            self._clean = 0
+            self.escalations += 1
+            return 1
+        if self._clean >= cfg.clean_window and self.level > 0:
+            self.level -= 1
+            self._hot = 0
+            self._clean = 0
+            self.deescalations += 1
+            return -1
+        return 0
+
+
+@dataclasses.dataclass(frozen=True)
+class DefensePlan:
+    """Resolved ``--defense`` CLI intent (see ``resolve``)."""
+
+    weighted: bool
+    escalate: bool
+    power: float = 2.0
+    floor: float = 0.1
+    halflife: float = 16.0
+    escalation: EscalationConfig = None
+
+    def policy(self):
+        return EscalationPolicy(self.escalation) if self.escalate else None
+
+
+def resolve(args):
+    """``DefensePlan`` from the CLI flags, or None when ``--defense`` is
+    off. ``--defense weighted`` enables suspicion weighting alone;
+    ``--defense escalate`` enables weighting AND the rule ladder (the
+    full closed loop). ``--defense_params`` tunes ``power``/``floor``/
+    ``halflife`` (the in-graph suspicion EMA's decay) and the escalation
+    knobs (``levels``/``theta_up``/``theta_down``/``patience``/
+    ``clean_window``)."""
+    mode = getattr(args, "defense", None)
+    if not mode or mode == "none":
+        return None
+    if mode not in ("weighted", "escalate"):
+        raise SystemExit(
+            f"unknown --defense mode {mode!r}; use weighted or escalate"
+        )
+    p = dict(getattr(args, "defense_params", None) or {})
+    esc = EscalationConfig(
+        levels=tuple(p.pop("levels", DEFAULT_LEVELS)),
+        theta_up=float(p.pop("theta_up", 0.5)),
+        theta_down=float(p.pop("theta_down", 0.2)),
+        patience=int(p.pop("patience", 3)),
+        clean_window=int(p.pop("clean_window", 12)),
+    )
+    plan = DefensePlan(
+        weighted=True,
+        escalate=(mode == "escalate"),
+        power=float(p.pop("power", 2.0)),
+        floor=float(p.pop("floor", 0.1)),
+        halflife=float(p.pop("halflife", 16.0)),
+        escalation=esc,
+    )
+    if p:
+        raise SystemExit(f"unknown --defense_params keys {sorted(p)}")
+    return plan

@@ -1,0 +1,286 @@
+"""Adaptive-adversary vs closed-loop-defense record (DEFBENCH_r*).
+
+The committed acceptance artifact of DESIGN.md §16, measured as matched
+accuracy CELLS on the on-mesh aggregathor topology (same task, same
+seed, same step budget — only the attack/defense column changes):
+
+  1. ``clean``              — no attack, vanilla krum: the accuracy bar.
+  2. ``static-lie``         — the oblivious ALIE attack (z = 1.035).
+  3. ``adaptive-lie``       — the suspicion-aware controller
+                              (attacks/adaptive.py) against the SAME
+                              vanilla krum: the bisection sustains a
+                              magnitude far above the static z, so the
+                              final accuracy must degrade MORE than the
+                              static cell's.
+  4. ``adaptive-defense``   — the same adaptive attack against the full
+                              closed loop (--defense escalate:
+                              suspicion-weighted rows + the
+                              krum -> multi-krum -> bulyan ladder,
+                              aggregators/defense.py): accuracy must
+                              come back to within ``--acc_margin`` of
+                              the clean bar.
+  5. ``adaptive-rotation``  — the adaptive attack rotating its active
+                              cohort over an f_pool = 2f colluder pool:
+                              every pool member's DECAYED suspicion must
+                              stay below the static-cohort cell's
+                              victim — the laundering the windowed
+                              score (MetricsHub suspicion_halflife)
+                              exists to expose.
+
+Each cell is one ``defense_bench`` record (telemetry schema v7) in the
+JSONL twin; the .json artifact adds the derived acceptance verdicts.
+Run (CPU container, ~2-4 min):
+
+  python -m garfield_tpu.apps.benchmarks.defense_bench \
+      --out DEFBENCH_r01 --num_iter 240
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import data as data_lib, parallel
+from ...aggregators import defense as defense_lib
+from ...attacks import LIE_Z
+from ...models import select_model
+from ...parallel import aggregathor
+from ...telemetry import exporters as tele_fmt, hub as hub_lib
+from ...utils import selectors
+
+N_WORKERS = 16
+F = 3  # bulyan (the ladder's top) needs n >= 4f + 3 = 15
+
+
+def _task(args):
+    # The default surrogate margin (3.5) is one-shot learnable — every
+    # cell saturates and no attack registers in accuracy. The committed
+    # record pins a HARD margin (overlapping classes) where a sustained
+    # gradient bias measurably moves the decision boundary; an explicit
+    # operator env still wins.
+    import os
+
+    os.environ.setdefault("GARFIELD_SURROGATE_MARGIN", str(args.margin))
+    module = select_model("pimanet", "pima")
+    loss = selectors.select_loss("bce")
+    opt = selectors.select_optimizer(
+        "sgd", lr=args.lr, momentum=0.0, weight_decay=0.0
+    )
+    m = data_lib.DatasetManager("pima", args.batch, N_WORKERS, N_WORKERS, 0)
+    m.num_ps = 0
+    xs, ys = m.sharded_train_batches()
+    test = parallel.EvalSet(m.get_test_set(), binary=True)
+    return module, loss, opt, xs, ys, test
+
+
+def run_cell(args, task, name, *, attack=None, attack_params=None,
+             defense=False, gar="krum"):
+    """One accuracy cell: train ``num_iter`` steps, return the record.
+
+    With ``defense`` this drives the SAME closed loop apps/common.py
+    deploys: the in-graph suspicion weighting (``defense=`` kwarg) plus
+    the host-side escalation policy fed by a MetricsHub's decayed
+    suspicion, rebuilding the trainer at level changes (the TrainState
+    carries across rebuilds — the ladder is stateful-homogeneous).
+    """
+    module, loss, opt, xs, ys, test = task
+    attack_params = dict(attack_params or {})
+    telemetry = defense or bool(args.halflife)
+    hub = hub_lib.MetricsHub(
+        num_ranks=N_WORKERS, suspicion_halflife=args.halflife,
+        meta={"tag": "defense_bench", "cell": name},
+    )
+    policy = None
+    gar_params = {}
+    if defense:
+        policy = defense_lib.EscalationPolicy(defense_lib.EscalationConfig(
+            theta_up=args.theta_up, theta_down=args.theta_down,
+            patience=args.patience, clean_window=args.clean_window,
+        ))
+        if gar in policy.config.levels:
+            policy.level = policy.config.levels.index(gar)
+        gar, gar_params = policy.current()
+
+    def build(g, gp):
+        return aggregathor.make_trainer(
+            module, loss, opt, g,
+            num_workers=N_WORKERS, f=F,
+            attack=attack, attack_params=attack_params,
+            gar_params=gp,
+            telemetry=telemetry,
+            defense=(
+                {"halflife": args.halflife or 16.0} if defense else None
+            ),
+        )
+
+    t0 = time.time()
+    init_fn, step_fn, eval_fn = build(gar, gar_params)
+    state = init_fn(jax.random.PRNGKey(args.seed), xs[0, 0])
+    x = jnp.asarray(xs[:, 0])
+    y = jnp.asarray(ys[:, 0])
+    escalations = 0
+    last_mag = None
+    num_batches = xs.shape[1]
+    for i in range(args.num_iter):
+        b = i % num_batches
+        state, metrics = step_fn(
+            state, jnp.asarray(xs[:, b]), jnp.asarray(ys[:, b])
+        )
+        if "attack_mag" in metrics:
+            last_mag = float(metrics["attack_mag"])
+        if telemetry and "tap" in metrics:
+            hub.record_step(i, loss=float(metrics["loss"]),
+                            tap=jax.device_get(metrics["tap"]))
+        if policy is not None:
+            susp = hub.suspicion_decayed()
+            if susp is not None:
+                act = policy.observe(float(
+                    defense_lib.suspicion_concentration(susp, F)
+                ))
+                if act:
+                    escalations += 1
+                    gar, gar_params = policy.current()
+                    print(f"[{name}] step {i}: defense "
+                          f"{'escalates' if act > 0 else 'de-escalates'} "
+                          f"to {policy.level_name!r}", flush=True)
+                    _, step_fn, eval_fn = build(gar, gar_params)
+    del x, y
+    acc = parallel.compute_accuracy(state, eval_fn, test, binary=True)
+    susp = hub.suspicion()
+    susp_d = hub.suspicion_decayed()
+    rec = tele_fmt.make_record(
+        "defense_bench",
+        cell=name,
+        gar=str(gar),
+        attack=attack,
+        defense="escalate" if defense else None,
+        n=N_WORKERS, f=F,
+        steps=int(args.num_iter),
+        seed=int(args.seed),
+        final_accuracy=round(float(acc), 6),
+        attack_magnitude=(
+            None if last_mag is None else round(last_mag, 6)
+        ),
+        escalations=int(escalations) if defense else None,
+        suspicion=(
+            None if susp is None else np.round(susp, 6).tolist()
+        ),
+        suspicion_decayed=(
+            None if susp_d is None else np.round(susp_d, 6).tolist()
+        ),
+        wall_s=round(time.time() - t0, 3),
+    )
+    print(f"[{name}] accuracy {acc:.4f} "
+          f"({rec['wall_s']}s, mag={rec['attack_magnitude']})", flush=True)
+    return rec
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", type=str, default="DEFBENCH",
+                   help="Artifact prefix: writes <out>.json + <out>.jsonl")
+    p.add_argument("--num_iter", type=int, default=300)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--margin", type=float, default=1.2,
+                   help="Surrogate class margin (GARFIELD_SURROGATE_"
+                        "MARGIN default for this run; lower = harder).")
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--mag_max", type=float, default=6.0,
+                   help="Adaptive bracket ceiling (lie z upper bound).")
+    p.add_argument("--halflife", type=float, default=24.0,
+                   help="Suspicion halflife (windowed score, schema v7).")
+    p.add_argument("--theta_up", type=float, default=0.35)
+    p.add_argument("--theta_down", type=float, default=0.1)
+    p.add_argument("--patience", type=int, default=4)
+    p.add_argument("--clean_window", type=int, default=60)
+    p.add_argument("--acc_margin", type=float, default=0.05,
+                   help="Defense cell must land within this of clean.")
+    p.add_argument("--degrade_margin", type=float, default=0.01,
+                   help="Adaptive must undercut static by at least this.")
+    args = p.parse_args(argv)
+
+    task = _task(args)
+    adaptive_params = {"mag_max": args.mag_max}
+    cells = [
+        run_cell(args, task, "clean"),
+        run_cell(args, task, "static-lie", attack="lie",
+                 attack_params={"z": LIE_Z}),
+        run_cell(args, task, "adaptive-lie", attack="adaptive-lie",
+                 attack_params=adaptive_params),
+        run_cell(args, task, "adaptive-defense", attack="adaptive-lie",
+                 attack_params=adaptive_params, defense=True),
+        run_cell(args, task, "adaptive-rotation", attack="adaptive-lie",
+                 attack_params={**adaptive_params, "f_pool": 2 * F,
+                                "rotation": 8}),
+    ]
+    by = {c["cell"]: c for c in cells}
+    acc = {k: c["final_accuracy"] for k, c in by.items()}
+
+    # Acceptance verdicts (ISSUE 10): the adaptive attack beats the
+    # static one against the vanilla rule; the closed loop restores the
+    # bar; rotation launders the cumulative score but NOT the decayed
+    # one below the static-cohort victim's.
+    pool = list(range(N_WORKERS - 2 * F, N_WORKERS))
+    static_cohort = list(range(N_WORKERS - F, N_WORKERS))
+    rot_d = by["adaptive-rotation"]["suspicion_decayed"]
+    adp_d = by["adaptive-lie"]["suspicion_decayed"]
+    rot_max = (
+        max(rot_d[r] for r in pool) if rot_d is not None else None
+    )
+    static_victim = (
+        max(adp_d[r] for r in static_cohort) if adp_d is not None else None
+    )
+    verdicts = {
+        "adaptive_beats_static": bool(
+            acc["adaptive-lie"]
+            <= acc["static-lie"] - args.degrade_margin
+        ),
+        "defense_restores_bar": bool(
+            acc["adaptive-defense"] >= acc["clean"] - args.acc_margin
+        ),
+        "rotation_launders_decayed_below_static_victim": (
+            None if rot_max is None or static_victim is None
+            else bool(rot_max < static_victim)
+        ),
+        "rotation_pool_max_decayed": rot_max,
+        "static_cohort_max_decayed": static_victim,
+    }
+    doc = {
+        "bench": "defense_bench",
+        "schema_v": tele_fmt.SCHEMA_VERSION,
+        "config": {
+            "n": N_WORKERS, "f": F, "num_iter": args.num_iter,
+            "batch": args.batch, "lr": args.lr, "seed": args.seed,
+            "mag_max": args.mag_max, "halflife": args.halflife,
+            "theta_up": args.theta_up, "theta_down": args.theta_down,
+            "patience": args.patience, "acc_margin": args.acc_margin,
+            "degrade_margin": args.degrade_margin,
+        },
+        "accuracy": acc,
+        "verdicts": verdicts,
+        "cells": cells,
+    }
+    with open(args.out + ".json", "w") as fp:
+        json.dump(doc, fp, indent=1)
+    with open(args.out + ".jsonl", "w") as fp:
+        for c in cells:
+            tele_fmt.validate_record(c)
+            fp.write(json.dumps(c) + "\n")
+    print(json.dumps({"accuracy": acc, "verdicts": verdicts}, indent=1))
+    ok = all(v for v in (
+        verdicts["adaptive_beats_static"],
+        verdicts["defense_restores_bar"],
+        verdicts["rotation_launders_decayed_below_static_victim"],
+    ))
+    print(f"defense_bench: {'ACCEPTED' if ok else 'REJECTED'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv[1:]))

@@ -469,7 +469,13 @@ def bench_scenario(scenario, n, d, wire_dtype, rounds, trials,
     if not straggler_ms:
         straggler_ms = max(20, int(baseline_s * 1e4))  # 10x, >= 20 ms
 
-    hub = tele_hub_lib.MetricsHub(num_ranks=n, meta={
+    # suspicion_halflife (schema v7): the scenario rows carry the
+    # WINDOWED suspicion too — a straggler/partition victim is a live
+    # condition, and the decayed score is what the report tool's
+    # cross-check (and the closed-loop defense) consumes; the cumulative
+    # score dilutes recovered victims with every clean round since.
+    hub = tele_hub_lib.MetricsHub(num_ranks=n, suspicion_halflife=rounds,
+                                  meta={
         "tag": "exchange-bench-scenario", "scenario": scenario,
     })
     tele_hub_lib.install(hub)
@@ -563,6 +569,7 @@ def bench_scenario(scenario, n, d, wire_dtype, rounds, trials,
         trace_lib.disable()
         tele_hub_lib.uninstall()
     susp = hub.suspicion()
+    susp_d = hub.suspicion_decayed()
     stale = hub.staleness_stats()
     phase_stats = hub.phase_stats() or {}
     phases = {
@@ -589,6 +596,11 @@ def bench_scenario(scenario, n, d, wire_dtype, rounds, trials,
         "suspicion": (
             None if susp is None
             else [round(float(s), 6) for s in susp]
+        ),
+        # schema v7: the halflife-decayed twin (the live-victim signal).
+        "suspicion_decayed": (
+            None if susp_d is None
+            else [round(float(s), 6) for s in susp_d]
         ),
         "staleness_mean": None if stale is None else round(stale["mean"], 4),
         "phases": phases or None,

@@ -63,7 +63,7 @@ import numpy as np
 import optax
 from jax.flatten_util import ravel_pytree
 
-from ..aggregators import gars
+from ..aggregators import defense as defense_lib, gars
 from ..parallel import core
 from ..telemetry import hub as tele_hooks, trace as tele_trace
 from ..utils import multihost, rounds, tools, wire
@@ -108,6 +108,24 @@ def _host_attack(name, params, fw):
 
     if name is None:
         return None, None, None
+    if name in ("adaptive-lie", "adaptive-empire"):
+        # Suspicion-aware attacker (attacks/adaptive.py, DESIGN.md §16):
+        # the worker loop builds the HostController itself (it needs the
+        # cluster's worker count and its own rank for the rotation
+        # schedule); the cohort size rides the same local-simulation
+        # budget as the oblivious colluding attacks. adaptive-lie floors
+        # it at TWO: the Bessel sigma of one sample is NaN (the
+        # reference's emergent fw=1 behavior), and a NaN fake is
+        # self-defeating for a controller whose whole point is staying
+        # admitted — it reads back "excluded" forever.
+        floor = 2 if name == "adaptive-lie" else 1
+        cohort = int(params.get("cohort", max(fw, floor)))
+        if cohort < floor:
+            raise SystemExit(
+                f"--attack {name!r} needs a cohort of at least {floor} "
+                f"honest gradients to simulate (got {cohort})"
+            )
+        return "adaptive", None, cohort
     scale = float(params.get("scale", 100.0))
     rng = np.random.default_rng(int(params.get("seed", 666)))
     if name == "random":
@@ -202,6 +220,7 @@ def _telemetry_open(args, who, num_ranks=None, meta=None):
     )
     hub = tele_hooks.MetricsHub(
         num_ranks=num_ranks,
+        suspicion_halflife=common.resolve_suspicion_halflife(args),
         meta={"tag": who, "gar": args.gar, "fw": args.fw, **(meta or {})},
         sink=exp,
     )
@@ -838,6 +857,32 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
 
     f = args.fw
     gar = gars[args.gar]
+    gar_params = dict(getattr(args, "gar_params", None) or {})
+    base_gar_params = dict(gar_params)
+    # Closed-loop defense (DESIGN.md §16): suspicion weighting + rule
+    # escalation on the host plane. The suspicion source is this PS's
+    # own MetricsHub (it sees the real arrival-order quorums), so
+    # --defense implies --telemetry like --trace does.
+    defense_plan = defense_lib.resolve(args)
+    esc_policy = None
+    if defense_plan is not None:
+        if not getattr(args, "telemetry", None):
+            args.telemetry = "telemetry"
+        if defense_plan.escalate:
+            allowed = sorted(
+                k for k in defense_lib.LEVEL_RULES if k in gars
+            )
+            if args.gar not in allowed:
+                raise SystemExit(
+                    f"--defense escalate needs --gar to name a REGISTERED "
+                    f"escalation-ladder rule ({allowed}), got {args.gar!r}"
+                )
+            esc_policy = defense_plan.policy()
+            if args.gar in esc_policy.config.levels:
+                esc_policy.level = esc_policy.config.levels.index(args.gar)
+            lvl_gar, lvl_params = esc_policy.current()
+            gar = gars[lvl_gar]
+            gar_params = {**base_gar_params, **lvl_params}
     opt_state0 = optimizer.init(params0)
     bn0_flat, bn_unravel = ravel_pytree(ms0)
     bn_elems = int(np.asarray(bn0_flat).size)
@@ -854,8 +899,6 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
         test_batches, binary=args.dataset == "pima"
     )
 
-    gar_params = dict(getattr(args, "gar_params", None) or {})
-
     gar_base_key = jax.random.PRNGKey(args.seed)
 
     # Telemetry plane (docs/TELEMETRY.md): this PS is the deployment's
@@ -869,50 +912,58 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
         args, "cluster-ps", num_ranks=n_w,
         meta={"attack": getattr(args, "attack", None), "q": q},
     )
-    tap_fn = None
-    if tele_hub is not None:
+    def _build_tap(g, gp):
         from ..telemetry import taps as taps_lib
 
         @jax.jit
         def tap_fn(stack, sel):
-            bundle = taps_lib.compute_flat(
-                gar.name, stack, f, params=gar_params
-            )
+            bundle = taps_lib.compute_flat(g.name, stack, f, params=gp)
             return taps_lib.scatter(bundle, sel, n_w)
 
-    def _update_body(flat_params, opt_state, grads_stack, step):
-        # f=0 with the default rule short-circuits to the mean, but an
-        # explicitly requested rule (e.g. cclip, which is valid at f=0)
-        # must run — silently averaging would fake the defense. Randomized
-        # rules (condense) need a fresh per-step key: without it the fixed
-        # keyless fallback would apply the SAME coordinate mask every
-        # iteration under jit.
-        if f or args.gar != "average":
-            agg = gar.unchecked(
-                grads_stack, f=f,
-                key=jax.random.fold_in(gar_base_key, step), **gar_params,
-            )
-        else:
-            agg = jnp.mean(grads_stack, axis=0)
-        params = unravel(flat_params)
-        updates, opt_state = optimizer.update(
-            unravel(agg), opt_state, params
-        )
-        params = optax.apply_updates(params, updates)
-        return ravel_pytree(params)[0], opt_state
+        return tap_fn
 
-    ps_update = jax.jit(_update_body)
-    # Bounded-staleness update (DESIGN.md §14): the discount weights are
-    # composed into the stack BEFORE the GAR — Kardam's dampening, one
-    # row-scale multiply — so any registered rule aggregates the weighted
-    # rows. A fully-fresh quorum (all weights exactly 1.0) dispatches
-    # ps_update instead: same program as the synchronous path, which is
-    # the --max_staleness 0 bitwise-equality contract.
-    ps_update_weighted = jax.jit(
-        lambda fp, ost, stack, w, step: _update_body(
-            fp, ost, stack * w[:, None], step
+    tap_fn = _build_tap(gar, gar_params) if tele_hub is not None else None
+
+    def _build_updates(g, gp):
+        """(ps_update, ps_update_weighted) jits for one rule — rebuilt on
+        a defense-escalation level change (same shape, new selection)."""
+
+        def _update_body(flat_params, opt_state, grads_stack, step):
+            # f=0 with the default rule short-circuits to the mean, but
+            # an explicitly requested rule (e.g. cclip, valid at f=0)
+            # must run — silently averaging would fake the defense.
+            # Randomized rules (condense) need a fresh per-step key:
+            # without it the fixed keyless fallback would apply the SAME
+            # coordinate mask every iteration under jit.
+            if f or g.name != "average":
+                agg = g.unchecked(
+                    grads_stack, f=f,
+                    key=jax.random.fold_in(gar_base_key, step), **gp,
+                )
+            else:
+                agg = jnp.mean(grads_stack, axis=0)
+            params = unravel(flat_params)
+            updates, opt_state2 = optimizer.update(
+                unravel(agg), opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
+            return ravel_pytree(params)[0], opt_state2
+
+        # Bounded-staleness / suspicion-weighted update (DESIGN.md §14,
+        # §16): the weights are composed into the stack BEFORE the GAR —
+        # Kardam's dampening and the defense's suspicion discount share
+        # one row-scale multiply — so any registered rule aggregates the
+        # weighted rows. A fully-fresh, fully-trusted quorum (all
+        # weights exactly 1.0) dispatches the unweighted jit instead:
+        # same program as the synchronous path, which is the
+        # --max_staleness 0 bitwise-equality contract.
+        return jax.jit(_update_body), jax.jit(
+            lambda fp, ost, stack, w, step: _update_body(
+                fp, ost, stack * w[:, None], step
+            )
         )
-    )
+
+    ps_update, ps_update_weighted = _build_updates(gar, gar_params)
 
     def acc_eval(state_flat):
         return parallel.compute_accuracy(
@@ -1067,6 +1118,30 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
                         bn_mean = _robust_stats(
                             np.stack([rows[k][1] for k in quorum]), f
                         )
+                if defense_plan is not None and tele_hub is not None:
+                    # Suspicion weighting (DESIGN.md §16): the quorum's
+                    # rows enter the GAR scaled by their ranks' decayed,
+                    # median-relative suspicion — composed with the
+                    # staleness discount through the same row-scale
+                    # multiply. A clean history is all-exactly-1.0 and
+                    # keeps the unweighted program.
+                    susp = tele_hub.suspicion_decayed()
+                    if susp is not None:
+                        qidx = [k - worker_ranks[0] for k in quorum]
+                        w_def = np.asarray(defense_lib.suspicion_weights(
+                            susp, power=defense_plan.power,
+                            floor=defense_plan.floor,
+                        ))[qidx].astype(np.float32)
+                        tele_hooks.emit_event(
+                            "defense_weights", who="cluster-ps",
+                            step=int(i),
+                            ranks=[int(x) for x in qidx],
+                            weights=[round(float(x), 6) for x in w_def],
+                        )
+                        if not np.all(w_def == 1.0):
+                            w = w_def if w is None else (
+                                np.asarray(w) * w_def
+                            ).astype(np.float32)
                 if w is not None and not np.all(w == 1.0):
                     stack_gar = stack * jnp.asarray(w)[:, None]
                     flat_dev, opt_state = ps_update_weighted(
@@ -1099,6 +1174,55 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
                         i, tap=tap_fn(stack_gar, sel),
                         step_time_s=time.time() - t_step,
                     )
+            if esc_policy is not None and tele_hub is not None:
+                # Rule escalation (DESIGN.md §16): fold this round's
+                # suspicion concentration into the hysteresis ladder; a
+                # level change swaps the jitted update + audit programs
+                # (the host-plane twin of the on-mesh re-jit). A level
+                # infeasible at this quorum size (bulyan needs
+                # q >= 4f+3) is refused loudly and reverted.
+                susp = tele_hub.suspicion_decayed()
+                if susp is not None:
+                    conc = float(defense_lib.suspicion_concentration(
+                        susp, max(1, f)
+                    ))
+                    act = esc_policy.observe(conc)
+                    if act:
+                        name, lvl_params = esc_policy.current()
+                        new_gar = gars[name]
+                        msg = new_gar.check(
+                            np.zeros((q, 4), np.float32), f=f
+                        ) if f else None
+                        if msg is not None:
+                            tools.warning(
+                                f"[cluster-ps] defense cannot escalate "
+                                f"to {name!r} at q={q}: {msg}"
+                            )
+                            esc_policy.level -= act
+                        else:
+                            gar = new_gar
+                            gar_params = {**base_gar_params, **lvl_params}
+                            ps_update, ps_update_weighted = _build_updates(
+                                gar, gar_params
+                            )
+                            tap_fn = _build_tap(gar, gar_params)
+                            tools.warning(
+                                f"[cluster-ps] defense "
+                                f"{'escalates' if act > 0 else 'de-escalates'}"
+                                f" to {esc_policy.level_name!r} at step {i} "
+                                f"(suspicion concentration {conc:.3f})"
+                            )
+                            tele_hooks.emit_event(
+                                "defense_escalate", who="cluster-ps",
+                                step=int(i),
+                                level=int(esc_policy.level),
+                                rule=str(esc_policy.level_name),
+                                direction=(
+                                    "escalate" if act > 0 else "deescalate"
+                                ),
+                                gar=name,
+                                concentration=round(conc, 6),
+                            )
             if scaler is not None:
                 # Load control (DESIGN.md §15): fold this round's wall
                 # time + admissibility margin into the controller; spawn/
@@ -1438,6 +1562,11 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
     f = args.fw
     fps = getattr(args, "fps", 0)
     gar = gars[args.gar]
+    if getattr(args, "defense", None):
+        tools.warning(
+            "--defense is deployed on the SSMW PS and the on-mesh "
+            "topologies; MSMW replicas run the configured rule unchanged"
+        )
     model_gar_name = getattr(args, "model_gar", None) or args.gar
     model_attack = _host_model_attack(
         getattr(args, "ps_attack", None),
@@ -1824,6 +1953,21 @@ def _run_learn(args):
     atk_kind, attack, atk_cohort = _host_attack(
         args.attack, args.attack_params, f
     )
+    if atk_kind == "adaptive":
+        # The LEARN gossip plane has no single broadcast-model feedback
+        # channel (every node aggregates its own view), so the adaptive
+        # controller's probe is undefined here — reject loudly instead
+        # of silently running an oblivious loop.
+        raise SystemExit(
+            f"--attack {args.attack!r} drives the PS-topology worker "
+            "role; LEARN nodes support the oblivious attacks "
+            "(random/reverse/lie/empire)"
+        )
+    if getattr(args, "defense", None):
+        tools.warning(
+            "--defense is deployed on the SSMW PS and the on-mesh "
+            "topologies; LEARN nodes run the configured rule unchanged"
+        )
     model_attack = _host_model_attack(
         getattr(args, "model_attack", None),
         dict(getattr(args, "model_attack_params", None) or {}),
@@ -2504,6 +2648,76 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
     atk_kind, attack, atk_cohort = _host_attack(
         args.attack, args.attack_params, args.fw
     )
+    # Adaptive attacker (attacks/adaptive.py, DESIGN.md §16): this process
+    # is a REAL suspicion-aware Byzantine worker — bisection magnitude fed
+    # by its own published-frame fate (the broadcast model delta, or a
+    # leaked PS audit stream via attack_params {"feedback_taps": path}),
+    # deterministic cohort rotation over the f_pool colluders, and
+    # full-magnitude bursts when the model-broadcast cadence blows out (a
+    # quorum-degradation window: straggler / soft timeout / partition).
+    controller = None
+    adaptive_base = None
+    feedback_taps = None
+    pending_probe = None  # (round, excess u, mu estimate, magnitude)
+    last_model = None  # (round, flat np model) for the delta probe
+    if atk_kind == "adaptive":
+        from ..attacks import adaptive as adaptive_lib
+
+        if args.fw < 1:
+            raise SystemExit(
+                f"--attack {args.attack!r} needs --fw >= 1 (the declared "
+                "active-cohort size)"
+            )
+        cfg_all = multihost.ClusterConfig(args.cluster)
+        acfg = adaptive_lib.configure(
+            args.attack, args.attack_params,
+            num_workers=len(cfg_all.workers), f=args.fw,
+        )
+        controller = adaptive_lib.HostController(
+            acfg, windex,
+            burst_factor=float(args.attack_params.get("burst_factor", 3.0)),
+            burst_rounds=int(args.attack_params.get("burst_rounds", 3)),
+        )
+        adaptive_base = acfg.base
+        feedback_taps = args.attack_params.get("feedback_taps")
+
+    def _note_model(step, flat_params):
+        """Adaptive feedback hook, called at every model arrival: close
+        the pending probe (delta probe against the previous round's
+        model, or the leaked audit stream when configured) and feed the
+        broadcast cadence to the burst trigger."""
+        nonlocal pending_probe, last_model
+        if controller is None:
+            return
+        from ..attacks import adaptive as adaptive_lib
+
+        controller.observe_round(time.time())
+        flat_np = np.asarray(flat_params, np.float32)
+        if pending_probe is not None:
+            pr_round, u, mu, mag = pending_probe
+            detected = score = None
+            if feedback_taps:
+                got = adaptive_lib.read_selected(feedback_taps, windex)
+                if got is not None and got[0] >= pr_round:
+                    detected, score = got[1] <= 0.0, got[1]
+            if (detected is None and last_model is not None
+                    and last_model[0] == pr_round
+                    and step == pr_round + 1):
+                detected, score = adaptive_lib.delta_probe(
+                    last_model[1], flat_np, u, mu_est=mu,
+                )
+            if detected is not None:
+                controller.feedback(detected)
+                tele_hooks.emit_event(
+                    "attack_adapt", step=int(pr_round),
+                    magnitude=round(float(mag), 6),
+                    detected=bool(detected),
+                    lo=round(controller.lo, 6), hi=round(controller.hi, 6),
+                    score=None if score is None else round(float(score), 6),
+                )
+            pending_probe = None
+        last_model = (int(step), flat_np)
+
     # Worker momentum (Karimireddy et al. 2021; same EMA + zeros init as the
     # on-mesh trainers, core.worker_mom_update): this process publishes its
     # EMA instead of the raw gradient. A Byzantine worker poisons whatever
@@ -2606,9 +2820,12 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
         --max_staleness 0 bitwise contract). ``--straggler_ms`` injects
         the scenario harness's reproducible slow-rank delay just before
         the publish."""
-        nonlocal ms, mom, loss
+        nonlocal ms, mom, loss, pending_probe
+        attacking = atk_kind == "cohort" or (
+            atk_kind == "adaptive" and controller.is_active(step)
+        )
         with tele_trace.span("grad_compute", step=int(step), refresh=int(r)):
-            if atk_kind == "cohort":
+            if attacking:
                 # Colluding attacker (byzWorker.py:114-125): compute the
                 # cohort's honest gradients locally on DISTINCT batches
                 # of the attacker's own shard, publish the collusion
@@ -2636,7 +2853,21 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
                         0.0 if mom is None else mom
                     )
                     rows = mom.astype(np.float32)
-                g = attack(rows)
+                if atk_kind == "adaptive":
+                    # Publish the base attack's collusion statistic at the
+                    # controller's CURRENT magnitude (burst-aware), and
+                    # arm the probe: the next model delta tells this rank
+                    # whether the fake entered the selection.
+                    mag = controller.magnitude()
+                    mu = rows.mean(axis=0)
+                    if adaptive_base == "empire":
+                        g = (-mag * mu).astype(np.float32)
+                    else:
+                        sigma = rows.std(axis=0, ddof=1)
+                        g = (mu + mag * sigma).astype(np.float32)
+                    pending_probe = (int(step), g - mu, mu, mag)
+                else:
+                    g = attack(rows)
             else:
                 key = jax.random.fold_in(base_key, step)
                 if r:
@@ -2711,6 +2942,7 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
                     peers=plane.ranks, transform=model_tf,
                 )
             flat_params = plane.aggregate(models_p)
+            _note_model(i, flat_params)
             if bn_elems:
                 # Adopt the robust-aggregated PS statistics (fps budget),
                 # the MSMW twin of the SSMW mean-stats adoption.
@@ -2767,6 +2999,7 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
                 0, step + 1, transform=model_tf
             )
             flat_params, bn_seg = payload
+            _note_model(step, flat_params)
             if bn_elems:
                 # Adopt the PS's mean BatchNorm statistics — the cluster
                 # twin of the on-mesh core.mean_model_state sync.
@@ -2796,6 +3029,7 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
     summary = {
         "steps": steps_done,
         **({"refreshes": refreshes} if async_mode else {}),
+        **({"attack_adapt": controller.stats()} if controller else {}),
         "final_loss": float(loss) if loss is not None else None,
     }
     _telemetry_close(tele_hub, tele_exp)

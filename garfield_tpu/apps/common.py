@@ -59,9 +59,33 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
     # --- knobs hard-coded in the reference ---
     a("--attack", type=str, default=None,
       help="Byzantine gradient attack: random, reverse, drop, lie, empire, "
-           "crash.")
+           "crash — or an ADAPTIVE controller (DESIGN.md §16): "
+           "adaptive-lie, adaptive-empire (magnitude bisected against the "
+           "rule's selection feedback, cohort rotation over an f_pool > fw "
+           "colluder pool, full-magnitude bursts in quorum-degradation "
+           "windows).")
     a("--attack_params", type=json.loads, default={},
-      help="Attack parameters as JSON (e.g. lie z, empire eps).")
+      help="Attack parameters as JSON (e.g. lie z, empire eps; adaptive "
+           'controller knobs: {"f_pool": 4, "rotation": 8, "mag_max": 6.0, '
+           '"burst": 6.0}).')
+    a("--defense", type=str, default=None,
+      choices=["none", "weighted", "escalate"],
+      help="Closed-loop defense (aggregators/defense.py, DESIGN.md §16): "
+           "'weighted' scales each rank's rows by its (decayed) suspicion "
+           "before the GAR; 'escalate' adds the rule ladder "
+           "(krum -> multi-krum -> bulyan) driven by suspicion "
+           "concentration, with hysteresis. Off (default): the vanilla "
+           "rule — trajectories bitwise unchanged.")
+    a("--defense_params", type=json.loads, default={},
+      help="Defense knobs as JSON: power/floor (the suspicion-weight "
+           "law), halflife (suspicion EMA, steps), theta_up/theta_down/"
+           "patience/clean_window/levels (the escalation hysteresis).")
+    a("--suspicion_halflife", type=float, default=None,
+      help="Exponential halflife (in observed steps) of the telemetry "
+           "hub's WINDOWED suspicion score (schema v7): the decayed "
+           "score a rotated Byzantine cohort cannot launder by sitting "
+           "honest while its cumulative denominator grows. Default: env "
+           "GARFIELD_SUSPICION_HALFLIFE, else cumulative-only.")
     a("--subset", type=int, default=None,
       help="Async wait-for-q emulation: aggregate a random q-subset "
            "of worker gradients each step (server.py:134-155).")
@@ -203,6 +227,16 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
       help='Mesh axis layout, e.g. "workers=8" or "ps=2,workers=4"; '
            "default: all devices on the topology's main axis.")
     return p
+
+
+def resolve_suspicion_halflife(args):
+    """--suspicion_halflife with its GARFIELD_SUSPICION_HALFLIFE env twin
+    (the fleet-wide switch convention of utils/rounds.resolve)."""
+    hl = getattr(args, "suspicion_halflife", None)
+    if hl is None:
+        env = os.environ.get("GARFIELD_SUSPICION_HALFLIFE", "").strip()
+        hl = float(env) if env else None
+    return hl
 
 
 def parse_mesh(spec):
@@ -403,6 +437,28 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
     # the same stream as the per-step taps.
     from ..telemetry import trace as trace_lib
 
+    # Closed-loop defense (DESIGN.md §16): resolve the CLI intent early —
+    # escalation consumes the hub's suspicion, so it implies --telemetry
+    # the same way --trace does.
+    from ..aggregators import defense as defense_lib
+
+    defense_plan = defense_lib.resolve(args)
+    esc_policy = None
+    if defense_plan is not None and defense_plan.escalate:
+        if getattr(args, "gar", None) not in defense_lib.LEVEL_RULES:
+            raise SystemExit(
+                f"--defense escalate needs --gar to name an escalation-"
+                f"ladder rule ({sorted(defense_lib.LEVEL_RULES)}), got "
+                f"{args.gar!r}"
+            )
+        esc_policy = defense_plan.policy()
+        levels = esc_policy.config.levels
+        if args.gar in levels:
+            # Start the ladder AT the configured rule (e.g. --gar krum
+            # starts at the classic-krum level and escalates from there).
+            esc_policy.level = levels.index(args.gar)
+        if not getattr(args, "telemetry", None):
+            args.telemetry = "telemetry"  # suspicion needs the hub
     if trace_lib.requested(args) and not getattr(args, "telemetry", None):
         # Spans stream through the hub's JSONL sink; --trace without an
         # explicit --telemetry gets the default directory.
@@ -420,6 +476,7 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
         os.makedirs(args.telemetry, exist_ok=True)
         tele_hub = tele_hub_lib.MetricsHub(
             num_ranks=num_slots,
+            suspicion_halflife=resolve_suspicion_halflife(args),
             meta={
                 "tag": tag,
                 "gar": args.gar,
@@ -444,6 +501,25 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
 
     def build(step):
         kwargs = dict(make_trainer_kwargs)
+        gar_name = args.gar
+        gar_params = dict(getattr(args, "gar_params", None) or {})
+        if esc_policy is not None:
+            # The escalation ladder owns the rule (aggregators/defense.py):
+            # level changes rebuild the step here, exactly like the
+            # crash-schedule re-jit below.
+            gar_name, lvl_params = esc_policy.current()
+            gar_params.update(lvl_params)
+        if defense_plan is not None and "defense" in trainer_params:
+            kwargs["defense"] = {
+                "power": defense_plan.power,
+                "floor": defense_plan.floor,
+                "halflife": defense_plan.halflife,
+            }
+        elif defense_plan is not None and step == start_iter:
+            tools.warning(
+                f"[{tag}] --defense: this topology has no in-graph "
+                "suspicion weighting; applying rule escalation only"
+            )
         if getattr(args, "gar_dtype", None):
             kwargs["gar_dtype"] = (
                 jnp.bfloat16 if args.gar_dtype == "bfloat16"
@@ -452,8 +528,8 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
         if (getattr(args, "worker_momentum", None) is not None
                 and "worker_momentum" in trainer_params):
             kwargs["worker_momentum"] = args.worker_momentum
-        if getattr(args, "gar_params", None) and "gar_params" in trainer_params:
-            kwargs["gar_params"] = args.gar_params
+        if gar_params and "gar_params" in trainer_params:
+            kwargs["gar_params"] = gar_params
         if tele_hub is not None and "telemetry" in trainer_params:
             kwargs["telemetry"] = True
         if "num_iter" in trainer_params:
@@ -471,7 +547,7 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                 # model either — zero it with the model-space crash attack.
                 kwargs["model_attack"] = "crash"
         return topology.make_trainer(
-            module, loss_fn, optimizer, args.gar, mesh=mesh, **kwargs
+            module, loss_fn, optimizer, gar_name, mesh=mesh, **kwargs
         )
 
     chunk = args.chunk_steps
@@ -625,6 +701,57 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                     tap=m_j.get("tap"),
                     step_time_s=timer.last() if args.bench else None,
                 )
+                if "attack_mag" in m_j:
+                    # Adaptive-controller observability (schema v7): the
+                    # magnitude the attacker played and the verdict it
+                    # read back, one event per step.
+                    tele_hub.record_event(
+                        "attack_adapt",
+                        step=int(i + j),
+                        magnitude=float(m_j["attack_mag"]),
+                        detected=bool(m_j["attack_detected"] > 0.5),
+                    )
+                if "defense_w" in m_j:
+                    # Suspicion weights the step composed (schema v7) —
+                    # the hub digests them into summary.defense.
+                    tele_hub.record_event(
+                        "defense_weights",
+                        step=int(i + j),
+                        weights=np.round(
+                            np.asarray(m_j["defense_w"], np.float64), 6
+                        ).tolist(),
+                    )
+        if esc_policy is not None and tele_hub is not None:
+            # Closed-loop escalation (DESIGN.md §16): fold the windowed
+            # suspicion's concentration into the hysteresis policy once
+            # per dispatch; a level change rebuilds the step exactly
+            # like a crash-schedule event (same TrainState structure —
+            # the ladder is stateful-homogeneous by construction).
+            susp = tele_hub.suspicion_decayed()
+            if susp is not None:
+                conc = defense_lib.suspicion_concentration(
+                    susp, max(1, declared_f)
+                )
+                act = esc_policy.observe(float(conc))
+                if act:
+                    tools.info(
+                        f"[{tag}] defense "
+                        f"{'escalates' if act > 0 else 'de-escalates'} to "
+                        f"{esc_policy.level_name!r} at step {end - 1} "
+                        f"(suspicion concentration {float(conc):.3f})"
+                    )
+                    tele_hub.record_event(
+                        "defense_escalate",
+                        step=int(end - 1),
+                        level=int(esc_policy.level),
+                        rule=str(esc_policy.level_name),
+                        direction=(
+                            "escalate" if act > 0 else "deescalate"
+                        ),
+                        concentration=round(float(conc), 6),
+                    )
+                    _, step_fn, _ = build(end)
+                    chunk_fns.clear()
         if args.log:
             losses = np.asarray(metrics["loss"]).reshape(-1)
             for j in range(k):

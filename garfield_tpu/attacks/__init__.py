@@ -42,6 +42,8 @@ __all__ = [
     "GradientAttackFold",
     "plan_gradient_attack_fold",
     "plan_model_attack_fold",
+    "note_attack_fallback",
+    "reset_attack_fallback",
 ]
 
 
@@ -267,6 +269,35 @@ def _shared_fake_builder(byz_idx, count, transform):
     return build_extra
 
 
+# One-time attack_fallback telemetry guard: the randomized attacks
+# (random, drop) have no folded form and silently keep the where-path —
+# benches comparing fold-path wins must see that attributed, not infer it
+# (docs/TELEMETRY.md v7). One event per (attack, why) per process.
+_FALLBACK_EMITTED = set()
+
+
+def note_attack_fallback(attack, *, path, why):
+    """Emit the one-time ``attack_fallback`` telemetry event: ``attack``
+    is taking ``path`` (e.g. "where") instead of the folded fast path
+    because ``why``. No-op when no MetricsHub is installed, and at most
+    once per (attack, why) per process so per-step plan rebuilds cannot
+    flood the stream."""
+    key = (str(attack), str(why))
+    if key in _FALLBACK_EMITTED:
+        return
+    _FALLBACK_EMITTED.add(key)
+    from ..telemetry import hub as _hub
+
+    _hub.emit_event(
+        "attack_fallback", attack=str(attack), path=str(path), why=str(why)
+    )
+
+
+def reset_attack_fallback():
+    """Test hook: forget which fallbacks were already reported."""
+    _FALLBACK_EMITTED.clear()
+
+
 def plan_gradient_attack_fold(attack, byz_mask, *, z=LIE_Z, eps=EMPIRE_EPS,
                               factor=REVERSE_FACTOR, **_):
     """Return the ``GradientAttackFold`` for ``attack``, or None when the
@@ -278,6 +309,14 @@ def plan_gradient_attack_fold(attack, byz_mask, *, z=LIE_Z, eps=EMPIRE_EPS,
     import numpy as np
 
     if attack is None or attack == "none" or os.environ.get("GARFIELD_NO_FOLD"):
+        return None
+    if attack in ("random", "drop"):
+        # The silent half of the fold dispatch, made loud (schema v7):
+        # these rows are freshly random every step, so there is no static
+        # remap+scale — the topology keeps the where-path.
+        note_attack_fallback(
+            attack, path="where", why="randomized attack has no folded form"
+        )
         return None
     mask = np.asarray(byz_mask, dtype=bool)
     n = mask.size

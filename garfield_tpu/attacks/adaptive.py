@@ -1,0 +1,534 @@
+"""Adaptive (suspicion-aware) Byzantine attack controllers (DESIGN.md §16).
+
+Every attack in ``attacks/__init__.py`` is OBLIVIOUS: a fixed lie/empire/
+reverse schedule that ignores what the defense observes. But the defense's
+own audit plane (telemetry taps -> per-rank suspicion, docs/TELEMETRY.md)
+computes exactly the signal a worst-case adversary would exploit — and
+"A Little Is Enough" (Baruch, Baruch & Goldberg, 2019; the source of our
+``lie_attack``) showed that magnitudes tuned *just under* the detection
+margin defeat Krum/Bulyan. This module closes the loop on the attacker's
+side with three stateful behaviors, shared by BOTH deployment scales:
+
+  1. **Magnitude modulation** — a bisection bracket ``[lo, hi]`` over the
+     attack magnitude (lie's ``z``, empire's ``eps``). Each round the
+     controller plays the midpoint; feedback is whether the Byzantine
+     cohort appeared in the rule's selection (``selection_indices`` /
+     audit taps in-graph, the broadcast model-delta probe on the host
+     plane). Detected (excluded) -> ``hi`` drops to the played value;
+     accepted -> ``lo`` rises to it. A bracket that collapses while still
+     accepted RE-EXPANDS upward, so the controller tracks a moving
+     threshold (the escalating defense of ``aggregators/defense.py``
+     shifts it mid-run) instead of freezing at the first fixed point.
+  2. **Cohort rotation** — the ``f`` ACTIVE attackers each round are a
+     sliding window over a pool of ``f_pool >= f`` colluding ranks
+     (inactive members behave honestly), so cumulative exclusion
+     frequency is laundered across the pool: no single rank accumulates
+     the suspicion a static cohort's victim does. The schedule is
+     deterministic in (round, config) — colluders agree without
+     communication, exactly like the reference's local-cohort trick.
+  3. **Burst timing** — full-magnitude attacks are reserved for
+     quorum-degradation windows, when the defense is weakest: in-graph,
+     a round whose staleness emulation hard-cuts an honest rank; on the
+     host plane, an inter-round gap blowout (soft-timeout / partition —
+     the PR-4 ban path's evidence machinery finally gets an opponent
+     that waits for it).
+
+The in-graph half threads its state through ``TrainState.attack_state``
+(and therefore through the ``lax.scan`` chunk carry for free); the
+magnitude composes into ``fold.plan_gradient_attack_fold``'s shared-fake
+row so the Gram fast path stays intact (parallel/aggregathor.py). The
+host half (``HostController``) drives a REAL Byzantine worker process in
+``apps/cluster.py`` (``--attack adaptive-lie``), reading its own
+published-frame fate from the broadcast model delta (``delta_probe``)
+or, when the operator leaks it, the PS's audit-tap stream
+(``read_selected``).
+"""
+
+import dataclasses
+
+import numpy as np
+
+__all__ = [
+    "ADAPTIVE_ATTACKS",
+    "AdaptiveConfig",
+    "is_adaptive",
+    "configure",
+    "base_params",
+    "magnitude_key",
+    "traced_fold_plan",
+    "init_state",
+    "played_magnitude",
+    "update_bracket",
+    "active_cohort",
+    "active_mask_traced",
+    "HostController",
+    "delta_probe",
+    "read_selected",
+]
+
+# Registry of adaptive attack names -> the oblivious base attack whose
+# row algebra they modulate. The base's shared-fake-row structure (ONE
+# vector from every active colluder) is what keeps the folded Gram fast
+# path applicable at a traced magnitude.
+ADAPTIVE_ATTACKS = {
+    "adaptive-lie": "lie",
+    "adaptive-empire": "empire",
+}
+
+# Default magnitude search brackets. lie: z (the reference precomputes
+# z_max = 1.035 for n=20, f=8 — the adaptive attacker searches far past
+# it, because a duplicated fake cluster defeats Krum's score at much
+# larger z). empire: eps (reference fixed eps = 10).
+_DEFAULT_BRACKET = {
+    "lie": (0.25, 6.0),
+    "empire": (0.05, 12.0),
+}
+# Fraction of the full bracket below which an accepted bracket is
+# considered collapsed and re-expands toward mag_max (threshold drift).
+_COLLAPSE_FRAC = 0.02
+
+
+def is_adaptive(attack):
+    """True when ``attack`` names an adaptive controller."""
+    return isinstance(attack, str) and attack in ADAPTIVE_ATTACKS
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptiveConfig:
+    """Static plan of one adaptive attack (shared by both scales).
+
+    ``pool`` holds the colluding ranks (``f_pool = len(pool) >= f``);
+    each round the ``f`` ACTIVE attackers are a window into the pool
+    advanced every ``rotation_period`` rounds (0 = static cohort =
+    ``pool[:f]``). ``mag_min``/``mag_max`` bracket the bisection;
+    ``burst_mag`` is the magnitude played inside degradation windows
+    (default: ``mag_max``); ``regrow`` is the re-expansion step of a
+    collapsed-but-accepted bracket.
+    """
+
+    base: str
+    n: int
+    f: int
+    pool: tuple
+    mag_min: float
+    mag_max: float
+    rotation_period: int = 0
+    burst_mag: float = None
+    regrow: float = 0.25
+
+    def __post_init__(self):
+        if self.base not in _DEFAULT_BRACKET:
+            raise ValueError(
+                f"unknown adaptive base attack {self.base!r}; "
+                f"available: {sorted(_DEFAULT_BRACKET)}"
+            )
+        if not (1 <= self.f <= len(self.pool)):
+            raise ValueError(
+                f"active cohort f={self.f} must be in [1, f_pool="
+                f"{len(self.pool)}]"
+            )
+        if len(set(self.pool)) != len(self.pool) or any(
+            not (0 <= int(r) < self.n) for r in self.pool
+        ):
+            raise ValueError(
+                f"pool {self.pool} must be distinct ranks in [0, {self.n})"
+            )
+        if not (0.0 < self.mag_min < self.mag_max):
+            raise ValueError(
+                f"need 0 < mag_min < mag_max, got "
+                f"[{self.mag_min}, {self.mag_max}]"
+            )
+        if self.burst_mag is None:
+            object.__setattr__(self, "burst_mag", float(self.mag_max))
+
+    @property
+    def f_pool(self):
+        return len(self.pool)
+
+    def pool_mask(self):
+        """(n,) bool over all ranks: True for every pool member — the
+        static superset of any round's active cohort (the trainers use
+        it for honest-loss masking and the declared-f accounting)."""
+        m = np.zeros(self.n, bool)
+        m[np.asarray(self.pool, np.int64)] = True
+        return m
+
+
+def configure(attack, params, *, num_workers, f):
+    """``AdaptiveConfig`` from an attack name + CLI ``attack_params``.
+
+    Recognized params (all optional): ``f_pool`` (colluder pool size,
+    default ``f``; the pool is the LAST f_pool ranks, matching
+    ``core.default_byz_mask``'s last-f convention), ``pool`` (explicit
+    rank list, overrides f_pool), ``mag_min``/``mag_max`` (bracket;
+    ``z_min``/``z_max`` and ``eps_min``/``eps_max`` are accepted
+    aliases), ``rotation`` (rounds per cohort window; 0 = static),
+    ``burst`` (degradation-window magnitude), ``regrow``.
+    """
+    if not is_adaptive(attack):
+        raise ValueError(f"{attack!r} is not an adaptive attack")
+    base = ADAPTIVE_ATTACKS[attack]
+    p = dict(params or {})
+    if f < 1:
+        raise ValueError(f"adaptive attacks need f >= 1 active ranks, got {f}")
+    pool = p.get("pool")
+    if pool is None:
+        f_pool = int(p.get("f_pool", f))
+        if f_pool < f:
+            raise ValueError(f"f_pool {f_pool} < f {f}")
+        pool = tuple(range(num_workers - f_pool, num_workers))
+    else:
+        pool = tuple(int(r) for r in pool)
+    lo_d, hi_d = _DEFAULT_BRACKET[base]
+    alias = "z" if base == "lie" else "eps"
+    lo = float(p.get("mag_min", p.get(f"{alias}_min", lo_d)))
+    hi = float(p.get("mag_max", p.get(f"{alias}_max", hi_d)))
+    return AdaptiveConfig(
+        base=base,
+        n=num_workers,
+        f=f,
+        pool=pool,
+        mag_min=lo,
+        mag_max=hi,
+        rotation_period=int(p.get("rotation", 0)),
+        burst_mag=(None if p.get("burst") is None else float(p["burst"])),
+        regrow=float(p.get("regrow", 0.25)),
+    )
+
+
+# Controller knobs that must NOT leak into the base attack functions'
+# ``**params`` (they swallow unknown kwargs, so a leak would be silent) —
+# plus the magnitude aliases themselves, which the controller OWNS.
+CONTROLLER_KEYS = frozenset({
+    "f_pool", "pool", "rotation", "burst", "regrow", "mag_min", "mag_max",
+    "z_min", "z_max", "eps_min", "eps_max", "feedback", "feedback_taps",
+    "threshold", "burst_factor", "burst_rounds", "cohort", "z", "eps",
+})
+
+
+def base_params(params):
+    """Attack params with every controller knob stripped — what flows to
+    the base attack function alongside the controller's magnitude."""
+    return {k: v for k, v in (params or {}).items()
+            if k not in CONTROLLER_KEYS}
+
+
+def magnitude_key(base):
+    """The base attack's magnitude kwarg name (lie's z, empire's eps)."""
+    return "z" if base == "lie" else "eps"
+
+
+def traced_fold_plan(cfg, magnitude):
+    """``GradientAttackFold`` for the adaptive attack at a TRACED
+    magnitude: the row remap is the base attack's static one (every
+    active colluder publishes ONE shared fake row), only the fake-row
+    transform closes over the traced scalar — so the Gram fast path of
+    ``parallel/fold.py`` survives magnitude adaptation unchanged.
+    Requires a static cohort (``rotation_period == 0``): rotation makes
+    the remap itself dynamic, and those configs keep the where-path."""
+    from . import GradientAttackFold, _shared_fake_builder
+
+    if cfg.rotation_period > 0:
+        raise ValueError("traced_fold_plan needs a static cohort")
+    mask = active_cohort(cfg, 0)
+    byz_idx = np.flatnonzero(mask)
+    if cfg.base == "lie":
+        transform = lambda mu, sigma: mu + magnitude * sigma  # noqa: E731
+    else:
+        transform = lambda mu, sigma: -magnitude * mu  # noqa: E731
+    return GradientAttackFold(
+        np.where(mask, cfg.n, np.arange(cfg.n)),
+        np.ones(cfg.n),
+        _shared_fake_builder(byz_idx, float(byz_idx.size), transform),
+    )
+
+
+# --- the bisection law (numpy scalars AND traced jnp, like rounds.py) ------
+
+
+def init_state(cfg):
+    """Initial controller state: the full bracket. In-graph this is the
+    ``TrainState.attack_state`` pytree (two f32 scalars riding the scan
+    carry); the host controller keeps the same two floats."""
+    import jax.numpy as jnp
+
+    return {
+        "lo": jnp.asarray(cfg.mag_min, jnp.float32),
+        "hi": jnp.asarray(cfg.mag_max, jnp.float32),
+    }
+
+
+def played_magnitude(lo, hi):
+    """The magnitude the controller plays for a bracket: the midpoint."""
+    return 0.5 * (lo + hi)
+
+
+def update_bracket(lo, hi, detected, *, mag_min, mag_max, regrow=0.25):
+    """One bisection step from this round's feedback.
+
+    ``detected`` True means the active cohort was EXCLUDED by the rule at
+    the played midpoint -> the threshold lies below it (``hi`` drops).
+    False means the cohort was admitted -> the threshold lies above
+    (``lo`` rises). A bracket narrower than ``_COLLAPSE_FRAC *
+    (mag_max - mag_min)`` RE-EXPANDS by ``regrow`` of the remaining
+    headroom in the direction the feedback points — up on acceptance,
+    down on detection: the exclusion threshold is NOT stationary (the
+    defense escalates mid-run, staleness weights shift), and a frozen
+    bracket would keep under-attacking a weakened threshold — or, worse,
+    keep over-attacking a TIGHTENED one forever (``lo`` only descends
+    through the detection-side re-expansion). Accepts python/numpy
+    scalars (host controller) or traced jnp values (the in-graph carry)
+    and computes with the matching backend — the same dual-backend
+    convention as ``utils.rounds.staleness_weights``. Returns
+    ``(lo, hi)`` float32.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    on_device = any(
+        isinstance(v, jax.Array) for v in (lo, hi, detected)
+    )
+    xp = jnp if on_device else np
+    lo = xp.asarray(lo, xp.float32)
+    hi = xp.asarray(hi, xp.float32)
+    det = xp.asarray(detected, bool)
+    z = xp.float32(0.5) * (lo + hi)
+    new_hi = xp.where(det, z, hi)
+    new_lo = xp.where(det, lo, z)
+    collapsed = (new_hi - new_lo) < _COLLAPSE_FRAC * (mag_max - mag_min)
+    new_hi = xp.where(
+        collapsed & ~det,
+        xp.minimum(
+            xp.float32(mag_max),
+            new_hi + xp.float32(regrow) * (xp.float32(mag_max) - new_hi),
+        ),
+        new_hi,
+    )
+    new_lo = xp.where(
+        collapsed & det,
+        xp.maximum(
+            xp.float32(mag_min),
+            new_lo - xp.float32(regrow) * (new_lo - xp.float32(mag_min)),
+        ),
+        new_lo,
+    )
+    return new_lo.astype(xp.float32), new_hi.astype(xp.float32)
+
+
+# --- cohort rotation --------------------------------------------------------
+
+
+def _rotation_offset(cfg, rnd):
+    if cfg.rotation_period <= 0:
+        return 0
+    return (int(rnd) // cfg.rotation_period) % cfg.f_pool
+
+
+def active_cohort(cfg, rnd):
+    """(n,) bool numpy mask of the ranks attacking at round ``rnd`` — the
+    host-plane schedule every colluder derives independently."""
+    mask = np.zeros(cfg.n, bool)
+    off = _rotation_offset(cfg, rnd)
+    for j in range(cfg.f):
+        mask[cfg.pool[(off + j) % cfg.f_pool]] = True
+    return mask
+
+
+def pool_positions(cfg):
+    """(n,) int32: each rank's position in the pool, -1 for honest ranks
+    — the static half of the traced rotation mask."""
+    pos = np.full(cfg.n, -1, np.int32)
+    for j, r in enumerate(cfg.pool):
+        pos[r] = j
+    return pos
+
+
+def active_mask_traced(cfg, step):
+    """Traced (n,) bool active-cohort mask at (traced) ``step``: the
+    in-graph twin of ``active_cohort``, identical for every concrete
+    step value (pinned in tests/test_adaptive.py)."""
+    import jax.numpy as jnp
+
+    pos = jnp.asarray(pool_positions(cfg))
+    if cfg.rotation_period <= 0:
+        return jnp.asarray(active_cohort(cfg, 0))
+    off = (step.astype(jnp.int32) // cfg.rotation_period) % cfg.f_pool
+    rel = jnp.mod(pos - off, cfg.f_pool)
+    return (pos >= 0) & (rel < cfg.f)
+
+
+# --- host-plane controller (real Byzantine worker processes) ---------------
+
+
+class HostController:
+    """The attacker brain of a real Byzantine worker process
+    (``apps/cluster.py --attack adaptive-lie``): bisection magnitude +
+    deterministic rotation + gap-triggered bursts, fed by the worker's
+    own observations (the broadcast model delta, its wall clock).
+
+    Burst policy: the controller keeps an EMA of the inter-round gap (the
+    cadence of model broadcasts it receives). A gap exceeding
+    ``burst_factor`` x the EMA is a quorum-degradation window — a
+    straggler, soft timeout or partition is slowing the PS — and the
+    next ``burst_rounds`` attacked rounds play ``cfg.burst_mag`` instead
+    of the bracket midpoint (and skip feedback updates: a burst is a
+    smash-and-grab, not a probe).
+    """
+
+    def __init__(self, cfg, my_rank, *, burst_factor=3.0, burst_rounds=3):
+        self.cfg = cfg
+        self.my_rank = int(my_rank)
+        self.lo = float(cfg.mag_min)
+        self.hi = float(cfg.mag_max)
+        self.burst_factor = float(burst_factor)
+        self.burst_rounds = int(burst_rounds)
+        self._burst_left = 0
+        self._gap_ema = None
+        self._last_t = None
+        self.probes = 0
+        self.detections = 0
+
+    def is_active(self, rnd):
+        """Whether THIS rank attacks at round ``rnd`` (rotation)."""
+        return bool(active_cohort(self.cfg, rnd)[self.my_rank])
+
+    def bursting(self):
+        return self._burst_left > 0
+
+    def magnitude(self):
+        """The magnitude to play this round."""
+        if self._burst_left > 0:
+            return float(self.cfg.burst_mag)
+        return float(played_magnitude(self.lo, self.hi))
+
+    def feedback(self, detected):
+        """Fold one selection observation into the bracket (no-op during
+        a burst — its magnitude is not the bracket's probe)."""
+        if self._burst_left > 0:
+            self._burst_left -= 1
+            return
+        self.probes += 1
+        self.detections += int(bool(detected))
+        self.lo, self.hi = (
+            float(v) for v in update_bracket(
+                self.lo, self.hi, bool(detected),
+                mag_min=self.cfg.mag_min, mag_max=self.cfg.mag_max,
+                regrow=self.cfg.regrow,
+            )
+        )
+
+    def observe_round(self, t_now):
+        """Feed one model-broadcast arrival time; returns True when this
+        arrival opened a degradation window (burst trigger)."""
+        t_now = float(t_now)
+        if self._last_t is None:
+            self._last_t = t_now
+            return False
+        gap = max(t_now - self._last_t, 0.0)
+        self._last_t = t_now
+        if self._gap_ema is None:
+            self._gap_ema = gap
+            return False
+        triggered = (
+            self._gap_ema > 0.0 and gap > self.burst_factor * self._gap_ema
+        )
+        # The blown-out gap must not poison the baseline EMA (it IS the
+        # anomaly); fold only ordinary gaps.
+        if not triggered:
+            self._gap_ema = 0.8 * self._gap_ema + 0.2 * gap
+        if triggered:
+            self._burst_left = self.burst_rounds
+        return triggered
+
+    def stats(self):
+        return {
+            "lo": round(self.lo, 6),
+            "hi": round(self.hi, 6),
+            "magnitude": round(self.magnitude(), 6),
+            "probes": self.probes,
+            "detections": self.detections,
+            "bursting": self.bursting(),
+        }
+
+
+def delta_probe(prev_flat, new_flat, fake_excess, mu_est=None, *,
+                threshold=0.05):
+    """Published-frame fate from the broadcast model delta.
+
+    The PS broadcasts its model every round; for a plain-SGD server the
+    round delta is ``-lr * aggregate``. If the attacker's fake vector
+    ``mu + z*sigma`` entered the selection with weight ``alpha``, the
+    aggregate is ``(1-alpha) * mu_sel + alpha * fake`` and the delta
+    carries an ``alpha * (fake - mu)`` component — the EXCESS direction
+    ``u = z*sigma`` the attacker itself constructed. The probe projects
+    the (negated) delta onto ``u`` after removing the component along
+    the attacker's own honest-mean estimate ``mu_est`` (the honest part
+    of the aggregate lies almost entirely along it, and ``<mu, sigma>``
+    is not small in general): excluded rounds leave only honest noise in
+    the residual, admitted rounds leave the ``alpha*u`` term.
+
+    Returns ``(detected, score)``: ``detected`` True when the normalized
+    residual projection falls below ``threshold`` — the cohort's rows
+    did NOT reach the aggregate.
+    """
+    d = np.asarray(prev_flat, np.float64) - np.asarray(new_flat, np.float64)
+    u = np.asarray(fake_excess, np.float64)
+    un = np.linalg.norm(u)
+    if un == 0.0 or not np.isfinite(un):
+        return True, 0.0
+    u = u / un
+    if mu_est is not None:
+        m = np.asarray(mu_est, np.float64)
+        mn = np.linalg.norm(m)
+        if mn > 0.0 and np.isfinite(mn):
+            m = m / mn
+            d = d - np.dot(d, m) * m
+            u = u - np.dot(u, m) * m
+            un2 = np.linalg.norm(u)
+            if un2 < 1e-12:
+                return True, 0.0  # fake excess lies along mu: unobservable
+            u = u / un2
+    dn = np.linalg.norm(d)
+    if dn == 0.0 or not np.isfinite(dn):
+        return True, 0.0
+    score = float(np.dot(d / dn, u))
+    return bool(score < threshold), score
+
+
+def read_selected(path, rank, *, tail_bytes=262144):
+    """Newest audit-tap verdict for ``rank`` from a PS telemetry JSONL.
+
+    The leaked-audit feedback channel (DESIGN.md §16): when the operator
+    exposes the PS's telemetry stream (or its /metrics endpoint), the
+    attacker reads its own ``selected`` entry directly instead of
+    probing the model delta. Tail-reads the last ``tail_bytes`` and
+    returns ``(step, selected)`` of the newest step record carrying a
+    tap, or None when none is found.
+    """
+    import json
+    import os
+
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as fp:
+            fp.seek(max(0, size - tail_bytes))
+            chunk = fp.read().decode("utf-8", "replace")
+    except OSError:
+        return None
+    for line in reversed(chunk.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn first line of the tail window
+        if rec.get("kind") != "step":
+            continue
+        tap = rec.get("tap")
+        if not tap:
+            continue
+        sel = tap.get("selected") or []
+        if rank < len(sel):
+            return int(rec.get("step", -1)), float(sel[rank])
+    return None

@@ -34,9 +34,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import aggregators
 from ..attacks import (
+    adaptive as adaptive_lib,
     apply_gradient_attack,
     apply_gradient_attack_tree,
     gradient_attacks,
+    note_attack_fallback,
 )
 from ..telemetry import taps as taps_lib
 from . import core, fold, mesh as mesh_lib
@@ -140,6 +142,7 @@ def make_trainer(
     num_iter=None,
     telemetry=False,
     staleness=None,
+    defense=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the SSMW topology.
 
@@ -208,6 +211,35 @@ def make_trainer(
     trajectories are BITWISE equal, the emulated half of the
     ``--max_staleness 0`` contract (tests/test_staleness.py).
 
+    ``attack`` may also name an ADAPTIVE controller (``adaptive-lie`` /
+    ``adaptive-empire``, attacks/adaptive.py, DESIGN.md §16): the lie/
+    empire magnitude becomes a bisection bracket carried in
+    ``TrainState.attack_state`` (and therefore through the chunk-scan
+    carry), fed back each step by whether the active cohort entered the
+    rule's selection; ``attack_params`` carries the controller knobs
+    (``f_pool``/``rotation``/``mag_min``/``mag_max``/``burst``). With a
+    static cohort on a Gram-form rule the traced magnitude composes into
+    the folded-attack fake row (``adaptive_lib.traced_fold_plan``) so
+    the fast path survives; rotation (``f_pool > f`` cohort laundering)
+    keeps the where-path (the remap itself becomes dynamic) — reported
+    once via the ``attack_fallback`` telemetry event. In-graph bursts
+    key on the staleness emulation: a round whose draw hard-cuts an
+    honest rank is a quorum-degradation window and the cohort plays
+    ``burst`` magnitude (no staleness emulation -> no bursts).
+
+    ``defense`` (aggregators/defense.py) is the closed-loop counterpart:
+    a dict with ``power``/``floor``/``halflife`` enabling SUSPICION
+    WEIGHTING — a per-rank exclusion EMA carried in
+    ``TrainState.defense_state`` (the in-graph emulation of the host
+    MetricsHub's decayed suspicion), mapped through
+    ``defense.suspicion_weights`` and composed into the SAME row-weight
+    algebra as the staleness discount (fold ``row_weights`` on Gram
+    rules, explicit row scaling elsewhere). ``defense=None`` (default)
+    traces nothing — trajectories are bitwise the undefended ones. Rule
+    ESCALATION lives above the trainer (apps/common.py rebuilds the step
+    on level changes, like the crash-schedule re-jit), so one policy
+    module serves both deployment scales.
+
     ``step_fn(state, x, y) -> (state, metrics)`` expects ``x``/``y`` with a
     leading ``num_workers`` axis, sharded over ``axis``; it is jit'd with
     replicated state output, so calling it in a loop keeps everything
@@ -216,6 +248,34 @@ def make_trainer(
     gar = _resolve_gar(gar)
     attack_params = dict(attack_params or {})
     gar_params = dict(gar_params or {})
+    # Adaptive attacks (DESIGN.md §16): resolve the controller config and
+    # strip it down to the BASE attack + cleaned params; the magnitude is
+    # supplied per step from the carried bracket, never from params.
+    adaptive_cfg = None
+    if adaptive_lib.is_adaptive(attack):
+        if byz_mask is not None:
+            raise ValueError(
+                "adaptive attacks derive their own Byzantine pool from "
+                'attack_params ("f_pool"/"pool"); an explicit byz_mask '
+                "would silently fight the rotation schedule — remove it"
+            )
+        if granularity == "layer":
+            raise ValueError(
+                "adaptive attacks need whole-model selection feedback; "
+                'granularity="layer" runs an independent GAR per tensor '
+                "with no single per-rank verdict"
+            )
+        adaptive_cfg = adaptive_lib.configure(
+            attack, attack_params, num_workers=num_workers, f=f
+        )
+        attack = adaptive_cfg.base
+        attack_params = adaptive_lib.base_params(attack_params)
+        byz_mask = adaptive_cfg.pool_mask()
+    if defense is not None and granularity == "layer":
+        raise ValueError(
+            "the suspicion-weighted defense needs whole-model selection "
+            'evidence; granularity="layer" has no per-rank verdict'
+        )
     if gar.stateful_center and "center" in gar_params:
         raise ValueError(
             f"{gar.name!r} carries its center across steps "
@@ -251,9 +311,51 @@ def make_trainer(
     # Folded attack plan: static for deterministic attacks on
     # fold-capable rules (Gram-form krum/average/bulyan; coordinate-wise
     # median/tmean via remapped-row kernels); None keeps the where-path
-    # (fold.plan_for).
-    fold_plan = fold.plan_for(gar, attack, byz_mask, attack_params)
+    # (fold.plan_for). Adaptive attacks fold only their TRACED-magnitude
+    # fake row (per-trace plan below) on Gram-form rules with a static
+    # cohort — rotation makes the remap dynamic, and the feedback needs
+    # the gram_select weights anyway.
+    fold_plan = None
+    adaptive_fold = False
+    if adaptive_cfg is not None:
+        import os
+
+        adaptive_fold = (
+            gar.gram_select is not None
+            and adaptive_cfg.rotation_period == 0
+            and not os.environ.get("GARFIELD_NO_FOLD")
+        )
+        if not adaptive_fold:
+            note_attack_fallback(
+                f"adaptive-{adaptive_cfg.base}", path="where",
+                why=(
+                    "cohort rotation makes the row remap dynamic"
+                    if adaptive_cfg.rotation_period > 0
+                    else "rule has no gram_select fold form"
+                ),
+            )
+    else:
+        fold_plan = fold.plan_for(gar, attack, byz_mask, attack_params)
     byz_mask = jnp.asarray(byz_mask, dtype=bool)
+    # Closed-loop defense (see docstring): normalized EMA/weighting knobs.
+    d_power = d_floor = d_decay = None
+    if defense is not None:
+        from ..aggregators import defense as defense_lib
+
+        dd = dict(defense)
+        d_power = float(dd.pop("power", 2.0))
+        d_floor = float(dd.pop("floor", 0.1))
+        halflife = float(dd.pop("halflife", 16.0))
+        if dd:
+            raise ValueError(f"unknown defense keys {sorted(dd)}")
+        if halflife <= 0.0:
+            raise ValueError(f"defense halflife must be > 0, got {halflife}")
+        # Per-step multiplicative decay of the carried exclusion EMA: the
+        # in-graph twin of MetricsHub(suspicion_halflife=).
+        d_decay = float(0.5 ** (1.0 / halflife))
+        defense_lib.suspicion_weights(
+            [0.0], power=d_power, floor=d_floor
+        )  # validate the knobs once, loudly
 
     # Bounded-staleness emulation (see docstring). Normalized here so the
     # trivially-synchronous configs drop the machinery at BUILD time: the
@@ -295,6 +397,12 @@ def make_trainer(
             # forms consume row values — route through the where-path,
             # which weights rows explicitly.
             fold_plan = None
+    if (defense is not None and fold_plan is not None
+            and gar.gram_select is None):
+        # Suspicion weights are row weights too (defense.suspicion_weights
+        # composes through the same algebra as the staleness discount) —
+        # same Gram-only fold constraint, same where-path fallback.
+        fold_plan = None
 
     init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
     # Slot-fused gradient twin (models/slotfused.py) when eligible, else
@@ -319,6 +427,19 @@ def make_trainer(
             gar_state = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params
             )
+        attack_state = None
+        if adaptive_cfg is not None:
+            # The bisection bracket starts wide open; the first rounds
+            # ARE the controller's probes (attacks/adaptive.py).
+            attack_state = adaptive_lib.init_state(adaptive_cfg)
+        defense_state = None
+        if defense is not None:
+            # Carried exclusion EMA: nothing observed yet, suspicion 0,
+            # weights exactly 1.0 — the clean-history identity.
+            defense_state = {
+                "obs": jnp.zeros((num_workers,), jnp.float32),
+                "exc": jnp.zeros((num_workers,), jnp.float32),
+            }
         state = core.TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -327,6 +448,8 @@ def make_trainer(
             rng=key if seed_rng is None else seed_rng,
             worker_mom=worker_mom,
             gar_state=gar_state,
+            attack_state=attack_state,
+            defense_state=defense_state,
         )
         return jax.device_put(state, repl)
 
@@ -393,9 +516,64 @@ def make_trainer(
                     stale_taus, decay=stale_decay, max_staleness=stale_ms
                 )
 
+        # Adaptive controller (DESIGN.md §16): play the carried bracket's
+        # midpoint, rotate the active cohort, and burst to full magnitude
+        # when the staleness emulation opens a quorum-degradation window
+        # (an honest rank hard-cut this round). All traced; nothing here
+        # exists in the program when the attack is oblivious.
+        act_mask = byz_mask
+        eff_params = attack_params
+        atk_mag = degraded = None
+        a_lo = a_hi = None
+        if adaptive_cfg is not None:
+            a_lo = state.attack_state["lo"]
+            a_hi = state.attack_state["hi"]
+            atk_mag = adaptive_lib.played_magnitude(a_lo, a_hi)
+            if stale_w is not None:
+                # Quorum-degradation window (emulated): an HONEST rank at
+                # the staleness cutoff's floor weight (or excluded
+                # outright) — the emulation clips taus to the cutoff, so
+                # the floor IS the hard-cut signature a host-plane
+                # straggler/partition produces.
+                floor_w = jnp.float32(
+                    (stale_decay ** stale_ms) * (1.0 + 1e-5)
+                )
+                degraded = jnp.any((stale_w <= floor_w) & ~byz_mask)
+                atk_mag = jnp.where(
+                    degraded, jnp.float32(adaptive_cfg.burst_mag), atk_mag
+                )
+            act_mask = adaptive_lib.active_mask_traced(
+                adaptive_cfg, state.step
+            )
+            eff_params = dict(attack_params)
+            eff_params[
+                adaptive_lib.magnitude_key(adaptive_cfg.base)
+            ] = atk_mag
+
+        # Closed-loop defense weights (aggregators/defense.py): suspicion
+        # from the carried exclusion EMA, composed into the SAME row-
+        # weight algebra as the staleness discount. Exactly 1.0 on a
+        # clean history (the weighted identity contract).
+        def_w = None
+        if defense is not None:
+            susp = state.defense_state["exc"] / jnp.maximum(
+                state.defense_state["obs"], 1e-6
+            )
+            def_w = defense_lib.suspicion_weights(
+                susp, power=d_power, floor=d_floor
+            )
+        row_w = stale_w
+        if def_w is not None:
+            row_w = def_w if row_w is None else row_w * def_w
+
+        # Selection feedback the two carries consume: the rule's (n,)
+        # selection weights (sel_w) and the observation mask (obs_vec).
+        need_sel = adaptive_cfg is not None or defense is not None
+        sel_w = quorum_idx = None
+
         agg_kwargs = dict(
-            attack=attack, attack_params=attack_params, gar=gar, f=f,
-            subset=subset, gar_params=gar_params, row_weights=stale_w,
+            attack=attack, attack_params=eff_params, gar=gar, f=f,
+            subset=subset, gar_params=gar_params, row_weights=row_w,
         )
         center_kw = (
             {"center": state.gar_state} if gar.stateful_center else {}
@@ -413,27 +591,39 @@ def make_trainer(
                 # _attack_then_aggregate, so tree and flat trajectories
                 # sample identical wait-n-f subsets.
                 sel = core.subset_indices(sub_key, num_workers, subset)
-            if fold_plan is not None:
+            if fold_plan is not None or adaptive_fold:
                 # Folded attack: poison the Gram, never the rows — the raw
                 # per-leaf Grams keep fusing into the backward epilogue
                 # like the fault-free step (parallel/fold.py; 1.16x on the
-                # krum+lie north-star). Staleness weights compose into the
-                # fold's row scales (row_weights), so the fast path
-                # survives the async emulation.
-                aggr_tree = fold.folded_tree_aggregate(
-                    gar, fold_plan, grads, f=f, key=gar_key,
-                    gar_params={**gar_params, **center_kw},
-                    subset_sel=sel, row_weights=stale_w,
+                # krum+lie north-star). Staleness/suspicion weights
+                # compose into the fold's row scales (row_weights), and
+                # the adaptive magnitude into the shared fake row
+                # (traced_fold_plan), so the fast path survives both the
+                # async emulation and the adaptive adversary.
+                plan_now = (
+                    adaptive_lib.traced_fold_plan(adaptive_cfg, atk_mag)
+                    if adaptive_fold else fold_plan
                 )
+                out = fold.folded_tree_aggregate(
+                    gar, plan_now, grads, f=f, key=gar_key,
+                    gar_params={**gar_params, **center_kw},
+                    subset_sel=sel, row_weights=row_w,
+                    return_weights=need_sel,
+                )
+                if need_sel:
+                    aggr_tree, sel_w = out
+                    quorum_idx = sel
+                else:
+                    aggr_tree = out
             else:
                 poisoned = apply_gradient_attack_tree(
-                    attack, grads, byz_mask, key=atk_key, **attack_params
+                    attack, grads, act_mask, key=atk_key, **eff_params
                 )
-                if stale_w is not None:
+                if row_w is not None:
                     # Weight the post-attack rows — what the host-plane
                     # PS aggregates (poisoned arrivals, then discounted).
                     poisoned = jax.tree.map(
-                        lambda l: (l * stale_w.reshape(
+                        lambda l: (l * row_w.reshape(
                             (num_workers,) + (1,) * (l.ndim - 1)
                         )).astype(l.dtype),
                         poisoned,
@@ -454,6 +644,9 @@ def make_trainer(
                         (num_workers,), jnp.float32
                     ).at[sel].set(w_sub)
                     aggr_tree = tree_weighted_sum(poisoned, w)
+                    if need_sel:
+                        sel_w = w
+                        quorum_idx = sel
                 else:
                     aggr_tree = gar.tree_aggregate(
                         poisoned, f=f, key=gar_key, **gar_params,
@@ -478,7 +671,7 @@ def make_trainer(
                 akey = jax.random.fold_in(atk_key, i)
                 gkey = jax.random.fold_in(gar_key, i)
                 aggr = _attack_then_aggregate(
-                    flat, byz_mask, akey, sub_key, gkey,
+                    flat, act_mask, akey, sub_key, gkey,
                     **agg_kwargs,
                     **({"center": c.reshape(-1)} if c is not None else {}),
                 )
@@ -491,10 +684,93 @@ def make_trainer(
                 if gar.stateful_center else {}
             )
             aggr = _attack_then_aggregate(
-                flat_stack, byz_mask, atk_key, sub_key, gar_key,
+                flat_stack, act_mask, atk_key, sub_key, gar_key,
                 **agg_kwargs, **flat_center,
             )
             aggr_tree = core.unflatten_like(params, aggr)
+
+        if need_sel and sel_w is None:
+            # Feedback fallback: the aggregation path exposed no selection
+            # weights (non-Gram rule, flat path, or full-participation
+            # tree aggregate) — recompute the rule's verdict over the
+            # SAME poisoned, weighted rows via the audit-tap machinery
+            # (exactly the telemetry recomputation below; XLA CSEs the
+            # shared subgraphs). Adaptive/defense-only cost.
+            flat_fb = core.flatten_rows(grads)
+            poisoned_fb = apply_gradient_attack(
+                attack, flat_fb, act_mask, key=atk_key, **eff_params
+            )
+            if row_w is not None:
+                poisoned_fb = (poisoned_fb * row_w[:, None]).astype(
+                    poisoned_fb.dtype
+                )
+            fb_center = (
+                ravel_pytree(state.gar_state)[0]
+                if gar.stateful_center else None
+            )
+            if subset is not None and subset < num_workers:
+                quorum_idx = core.subset_indices(
+                    sub_key, num_workers, subset
+                )
+                bundle = taps_lib.compute_flat(
+                    gar.name, poisoned_fb[quorum_idx], f, key=gar_key,
+                    params=gar_params, center=fb_center,
+                )
+                sel_w = jnp.zeros((num_workers,), jnp.float32).at[
+                    quorum_idx
+                ].set(bundle["selected"])
+            else:
+                bundle = taps_lib.compute_flat(
+                    gar.name, poisoned_fb, f, key=gar_key,
+                    params=gar_params, center=fb_center,
+                )
+                sel_w = bundle["selected"]
+
+        obs_vec = None
+        if need_sel:
+            if quorum_idx is not None:
+                obs_vec = jnp.zeros((num_workers,), jnp.float32).at[
+                    quorum_idx
+                ].set(1.0)
+            else:
+                obs_vec = jnp.ones((num_workers,), jnp.float32)
+
+        new_attack_state = state.attack_state
+        detected = None
+        if adaptive_cfg is not None:
+            # Feedback = was the active cohort admitted? Majority-excluded
+            # among the OBSERVED colluders counts as detected; a round
+            # that observed none (whole cohort outside the quorum) and a
+            # burst round (not the bracket's probe) hold the bracket.
+            act_f = act_mask.astype(jnp.float32) * obs_vec
+            cnt = jnp.sum(act_f)
+            admitted = jnp.sum((sel_w > 0).astype(jnp.float32) * act_f)
+            detected = admitted * 2.0 < cnt
+            upd_lo, upd_hi = adaptive_lib.update_bracket(
+                a_lo, a_hi, detected,
+                mag_min=adaptive_cfg.mag_min,
+                mag_max=adaptive_cfg.mag_max,
+                regrow=adaptive_cfg.regrow,
+            )
+            hold = cnt == 0.0
+            if degraded is not None:
+                hold = hold | degraded
+            new_attack_state = {
+                "lo": jnp.where(hold, a_lo, upd_lo),
+                "hi": jnp.where(hold, a_hi, upd_hi),
+            }
+
+        new_defense_state = state.defense_state
+        if defense is not None:
+            # The hub's exclusion law (observed minus admitted), carried
+            # as an exponentially-decayed EMA — the in-graph twin of
+            # MetricsHub(suspicion_halflife=).
+            ind = (sel_w > 0).astype(jnp.float32) * obs_vec
+            dec = jnp.float32(d_decay)
+            new_defense_state = {
+                "obs": state.defense_state["obs"] * dec + obs_vec,
+                "exc": state.defense_state["exc"] * dec + (obs_vec - ind),
+            }
 
         new_gar_state = state.gar_state
         if gar.stateful_center:
@@ -513,8 +789,21 @@ def make_trainer(
             opt_state=new_opt,
             worker_mom=new_mom,
             gar_state=new_gar_state,
+            attack_state=new_attack_state,
+            defense_state=new_defense_state,
         )
         metrics = {"loss": mean_loss}
+        if adaptive_cfg is not None:
+            # Controller observability (the app loop surfaces these as
+            # schema-v7 ``attack_adapt`` events): the magnitude actually
+            # played and whether the rule caught it this round.
+            metrics["attack_mag"] = jnp.asarray(atk_mag, jnp.float32)
+            metrics["attack_detected"] = detected.astype(jnp.float32)
+        if defense is not None:
+            # The suspicion weights actually composed this step (the app
+            # loop surfaces them as ``defense_weights`` events — the
+            # summary's suspicion-weight digest at the on-mesh scale).
+            metrics["defense_w"] = def_w
         if telemetry:
             # In-graph audit tap (telemetry/taps.py): recompute the
             # poisoned flat stack with the SAME keys the aggregation used
@@ -524,12 +813,13 @@ def make_trainer(
             # new_state, so the trajectory is untouched.
             flat_raw = core.flatten_rows(grads)
             poisoned = apply_gradient_attack(
-                attack, flat_raw, byz_mask, key=atk_key, **attack_params
+                attack, flat_raw, act_mask, key=atk_key, **eff_params
             )
-            if stale_w is not None:
+            if row_w is not None:
                 # The tap audits the rule's selection over the SAME rows
-                # the rule consumed — staleness-weighted included.
-                poisoned = (poisoned * stale_w[:, None]).astype(
+                # the rule consumed — staleness- and suspicion-weighted
+                # (and adaptively poisoned) included.
+                poisoned = (poisoned * row_w[:, None]).astype(
                     poisoned.dtype
                 )
             tap_center = (

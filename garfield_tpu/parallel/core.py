@@ -68,6 +68,17 @@ class TrainState:
     # coordinate-median pass, ~4 ms at ResNet-18 scale, PERF.md r5).
     # None for stateless rules.
     gar_state: object = None
+    # Adaptive-adversary controller state (attacks/adaptive.py, DESIGN.md
+    # §16): the bisection bracket {lo, hi} over the attack magnitude,
+    # updated each step from the rule's selection feedback. Riding in the
+    # TrainState means the lax.scan chunk carry threads it for free
+    # (core.make_chunked_step). None for oblivious attacks.
+    attack_state: object = None
+    # Closed-loop defense state (aggregators/defense.py): the carried
+    # per-rank exclusion EMA {obs, exc} the in-graph suspicion weights
+    # derive from — the on-mesh emulation of the host MetricsHub's
+    # decayed suspicion. None when the defense is off.
+    defense_state: object = None
 
 
 def make_worker_fns(module, loss_fn):

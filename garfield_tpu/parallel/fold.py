@@ -113,7 +113,7 @@ def _sanitize_gram(gram_p, row_scale):
 
 def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
                           gar_params=None, subset_sel=None,
-                          row_weights=None):
+                          row_weights=None, return_weights=False):
     """Aggregate a stacked gradient TREE under a folded attack plan.
 
     Args:
@@ -143,8 +143,15 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
         hard cutoff excludes rows BEFORE the fold; a traced zero weight
         would defeat the static crash-row sanitization).
 
+      return_weights: also return the rule's (n,) selection weights (the
+        ``gram_select`` output, scattered to the n logical ranks on the
+        subset path) — the feedback signal the adaptive-adversary and
+        closed-loop-defense carries consume (DESIGN.md §16) without a
+        second selection pass. Supported for ``gram_select`` rules only.
+
     Returns the aggregated gradient tree (no leading axis) — identical in
-    exact arithmetic to ``gar.tree_aggregate(where-poisoned tree)``.
+    exact arithmetic to ``gar.tree_aggregate(where-poisoned tree)``; with
+    ``return_weights``, the tuple ``(tree, weights)``.
 
     Two layouts, each the measured winner for its rule family (PERF.md r4):
 
@@ -173,6 +180,13 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
             "gram_select rules only — other fold forms consume row "
             "values; topologies route weighted aggregation there through "
             "the flat path"
+        )
+    if return_weights and gar.gram_select is None:
+        raise ValueError(
+            "return_weights needs a gram_select rule: only its selection "
+            "is one (n,) weight vector (the other fold forms compose "
+            "multi-row reductions) — the adaptive/defense carries route "
+            "other rules through the where-path's tap recomputation"
         )
     params = dict(gar_params or {})
     # Carried center (stateful rules, cclip): arrives as a params-shaped
@@ -217,9 +231,11 @@ def folded_tree_aggregate(gar, plan, stacked_tree, *, f, key=None,
             w = jnp.zeros((n,), jnp.float32).at[subset_sel].set(w_sub)
         else:
             w = gar.gram_select(gram_p, f=f, key=key, **params)
-        w = w.astype(jnp.float32) * scale
+        sel_w = w.astype(jnp.float32)  # raw selection, pre row-scale
+        w = sel_w * scale
         w_ext = jnp.zeros((n + plan.num_extra,), jnp.float32).at[rmap].add(w)
-        return tree_weighted_sum(ext, w_ext)
+        out = tree_weighted_sum(ext, w_ext)
+        return (out, sel_w) if return_weights else out
 
     if gar.fold_flat_aggregate is not None:
         # Iterative row-value rules (cclip): the rule needs actual row

@@ -63,12 +63,24 @@ SCHEMA = "garfield-telemetry"
 # scaleup/scaledown scenario fields (``pre_rate``, ``spike_rate``,
 # ``recovered_rate``, ``active_initial``, ``active_final``,
 # ``spawns``, ``retires``) plus the LEARN-scenario fields
-# (``learn_ms0_bitwise``). Older records still validate — consumers
-# key on field presence, not version.
-SCHEMA_VERSION = 6
+# (``learn_ms0_bitwise``). v7 (round 14, adaptive adversaries and the
+# closed-loop defense — DESIGN.md §16): the ``attack_adapt`` EVENT (one
+# adaptive-controller observation: magnitude played, detected verdict,
+# bracket), the ``defense_weights`` EVENT (the PS's per-round
+# suspicion-weight vector), the ``defense_escalate`` EVENT (one rule-
+# ladder transition), the ``attack_fallback`` EVENT (a randomized/
+# rotated attack keeping the where-path, emitted once — benches stop
+# misattributing fold-path wins), ``summary`` gained
+# ``suspicion_decayed``/``suspicion_halflife`` (the windowed score a
+# rotated cohort cannot launder) plus the ``defense``/``attack_adapt``
+# digests, and the new ``defense_bench`` kind (DEFBENCH_r*'s
+# accuracy-cell rows). Older records still validate — consumers key on
+# field presence, not version.
+SCHEMA_VERSION = 7
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
-         "transfer_bench", "exchange_bench", "hier_bench", "span")
+         "transfer_bench", "exchange_bench", "hier_bench", "span",
+         "defense_bench")
 
 
 def make_record(kind, **fields):
@@ -180,6 +192,63 @@ def validate_record(rec):
                     f"staleness.step must be a non-negative int, "
                     f"got {step!r}"
                 )
+        elif rec.get("event") == "attack_adapt":
+            # v7: one adaptive-controller observation (DESIGN.md §16).
+            if not _is_num(rec.get("magnitude")):
+                _fail(
+                    f"attack_adapt.magnitude must be a number, got "
+                    f"{rec.get('magnitude')!r}"
+                )
+            for key in ("lo", "hi"):
+                val = rec.get(key)
+                if val is not None and not _is_num(val):
+                    _fail(
+                        f"attack_adapt.{key} must be a number or null, "
+                        f"got {val!r}"
+                    )
+            det = rec.get("detected")
+            if det is not None and not isinstance(det, bool) \
+                    and not _is_num(det):
+                _fail(
+                    f"attack_adapt.detected must be a bool/number or "
+                    f"null, got {det!r}"
+                )
+        elif rec.get("event") == "defense_weights":
+            # v7: the PS's per-round suspicion-weight vector.
+            ws = rec.get("weights")
+            _check_float_list("defense_weights", "weights", ws)
+            ranks = rec.get("ranks")
+            if ranks is not None:
+                _check_float_list(
+                    "defense_weights", "ranks", ranks, len(ws)
+                )
+        elif rec.get("event") == "defense_escalate":
+            # v7: one rule-ladder transition of the closed-loop defense.
+            lvl = rec.get("level")
+            if not isinstance(lvl, int) or isinstance(lvl, bool) or lvl < 0:
+                _fail(
+                    f"defense_escalate.level must be a non-negative int, "
+                    f"got {lvl!r}"
+                )
+            if not isinstance(rec.get("rule"), str):
+                _fail(
+                    f"defense_escalate.rule must be a string, got "
+                    f"{rec.get('rule')!r}"
+                )
+            if rec.get("direction") not in ("escalate", "deescalate"):
+                _fail(
+                    f"defense_escalate.direction must be 'escalate' or "
+                    f"'deescalate', got {rec.get('direction')!r}"
+                )
+        elif rec.get("event") == "attack_fallback":
+            # v7: a fold-ineligible attack keeping the where-path, made
+            # loud (one-time per process).
+            for key in ("attack", "path", "why"):
+                if not isinstance(rec.get(key), str):
+                    _fail(
+                        f"attack_fallback.{key} must be a string, got "
+                        f"{rec.get(key)!r}"
+                    )
         elif rec.get("event") == "autoscale":
             # v6: one elastic-membership action (DESIGN.md §15).
             if rec.get("action") not in ("spawn", "retire"):
@@ -247,6 +316,31 @@ def validate_record(rec):
                     )
         if rec.get("suspicion") is not None:
             _check_float_list("summary", "suspicion", rec["suspicion"])
+        if rec.get("suspicion_decayed") is not None:
+            # v7: the windowed (halflife-decayed) score.
+            _check_float_list(
+                "summary", "suspicion_decayed", rec["suspicion_decayed"]
+            )
+        dfd = rec.get("defense")
+        if dfd is not None:
+            # v7: the closed-loop defense digest (hub.defense_stats).
+            if not isinstance(dfd, dict):
+                _fail(f"summary.defense must be an object, got {dfd!r}")
+            for key in ("rounds", "escalations", "deescalations"):
+                val = dfd.get(key)
+                if not isinstance(val, int) or isinstance(val, bool) \
+                        or val < 0:
+                    _fail(
+                        f"summary.defense.{key} must be a non-negative "
+                        f"int, got {val!r}"
+                    )
+            for key in ("mean_w", "min_w"):
+                val = dfd.get(key)
+                if val is not None and not _is_num(val):
+                    _fail(
+                        f"summary.defense.{key} must be a number or "
+                        f"null, got {val!r}"
+                    )
         st = rec.get("step_time")
         if st is not None:
             if not isinstance(st, dict):
@@ -333,6 +427,59 @@ def validate_record(rec):
             _fail(
                 f"hier_bench.peak_rss_bytes must be a non-negative int or "
                 f"null, got {rss!r}"
+            )
+    elif kind == "defense_bench":
+        # v7: one accuracy cell of the adaptive-attack / closed-loop-
+        # defense record (DEFBENCH_r*): which attack faced which rule
+        # under which defense, and where the accuracy landed.
+        if not isinstance(rec.get("cell"), str) or not rec["cell"]:
+            _fail(
+                f"defense_bench.cell must be a non-empty string, got "
+                f"{rec.get('cell')!r}"
+            )
+        for key in ("gar",):
+            if not isinstance(rec.get(key), str):
+                _fail(
+                    f"defense_bench.{key} must be a string, got "
+                    f"{rec.get(key)!r}"
+                )
+        atk = rec.get("attack")
+        if atk is not None and not isinstance(atk, str):
+            _fail(
+                f"defense_bench.attack must be a string or null, got {atk!r}"
+            )
+        dfs = rec.get("defense")
+        if dfs is not None and not isinstance(dfs, str):
+            _fail(
+                f"defense_bench.defense must be a string or null, got {dfs!r}"
+            )
+        for key in ("n", "f", "steps", "seed"):
+            val = rec.get(key)
+            if val is not None and (
+                not isinstance(val, int) or isinstance(val, bool)
+            ):
+                _fail(
+                    f"defense_bench.{key} must be an int or null, got {val!r}"
+                )
+        for key in ("final_accuracy", "final_loss", "attack_magnitude",
+                    "wall_s"):
+            val = rec.get(key)
+            if val is not None and not _is_num(val):
+                _fail(
+                    f"defense_bench.{key} must be a number or null, "
+                    f"got {val!r}"
+                )
+        for key in ("suspicion", "suspicion_decayed"):
+            val = rec.get(key)
+            if val is not None:
+                _check_float_list("defense_bench", key, val)
+        esc = rec.get("escalations")
+        if esc is not None and (
+            not isinstance(esc, int) or isinstance(esc, bool) or esc < 0
+        ):
+            _fail(
+                f"defense_bench.escalations must be a non-negative int "
+                f"or null, got {esc!r}"
             )
     elif kind == "transfer_bench":
         for key in ("devices", "d"):
@@ -575,12 +722,35 @@ def prometheus_text(hub):
                "Autoscale membership actions taken.",
                [({"action": "spawn"}, float(autos["spawns"])),
                 ({"action": "retire"}, float(autos["retires"]))])
+    dfs = hub.defense_stats()
+    if dfs is not None:
+        # v7: the closed-loop defense (DESIGN.md §16).
+        if dfs["level"] is not None:
+            metric("garfield_defense_level", "gauge",
+                   "Active escalation-ladder level of the closed-loop "
+                   "defense.", [({}, float(dfs["level"]))])
+        metric("garfield_defense_escalations_total", "counter",
+               "Rule-ladder transitions taken by the closed-loop defense.",
+               [({"direction": "escalate"}, float(dfs["escalations"])),
+                ({"direction": "deescalate"},
+                 float(dfs["deescalations"]))])
+        if dfs["min_w"] is not None:
+            metric("garfield_defense_min_weight", "gauge",
+                   "Smallest suspicion weight applied so far.",
+                   [({}, float(dfs["min_w"]))])
     susp = hub.suspicion()
     if susp is not None:
         metric("garfield_rank_suspicion", "gauge",
                "Cumulative exclusion frequency per rank under the active "
                "GAR (the Byzantine-audit signal).",
                [({"rank": str(i)}, float(s)) for i, s in enumerate(susp)])
+        if hub._halflife is not None:
+            susp_d = hub.suspicion_decayed()
+            metric("garfield_rank_suspicion_decayed", "gauge",
+                   "Exclusion frequency over the halflife-decayed window "
+                   "(v7; the score a rotated cohort cannot launder).",
+                   [({"rank": str(i)}, float(s))
+                    for i, s in enumerate(susp_d)])
         metric("garfield_rank_observed_total", "counter",
                "Quorum appearances per rank.",
                [({"rank": str(i)}, float(o))

@@ -45,9 +45,41 @@ class MetricsHub:
     concurrently with the training loop's ``record_step``.
     """
 
-    def __init__(self, num_ranks=None, capacity=2048, meta=None, sink=None):
+    def __init__(self, num_ranks=None, capacity=2048, meta=None, sink=None,
+                 suspicion_halflife=None):
         self.num_ranks = num_ranks
         self.meta = dict(meta or {})
+        # Windowed suspicion (schema v7, DESIGN.md §16): the cumulative
+        # exclusion frequency never decays, so a ROTATED Byzantine cohort
+        # launders it for free — each member attacks briefly, then sits
+        # honest while its denominator grows. With ``suspicion_halflife``
+        # (in observed steps) the hub additionally keeps exponentially
+        # decayed observed/excluded twins: suspicion_decayed() weights
+        # the recent window, so a rank that attacked 50 rounds ago and a
+        # rank attacking NOW stop looking identical. None keeps only the
+        # cumulative score (v1 behavior).
+        self._halflife = (
+            float(suspicion_halflife) if suspicion_halflife else None
+        )
+        if self._halflife is not None and self._halflife <= 0.0:
+            raise ValueError(
+                f"suspicion_halflife must be > 0, got {suspicion_halflife}"
+            )
+        self._susp_decay = (
+            0.5 ** (1.0 / self._halflife) if self._halflife else 1.0
+        )
+        self._observed_d = None
+        self._excluded_d = None
+        # Closed-loop defense accounting (schema v7): per-round
+        # suspicion-weight digests + escalation state, folded from the
+        # PS's ``defense_weights``/``defense_escalate`` events and the
+        # attacker-side ``attack_adapt`` stream.
+        self._defense = {
+            "rounds": 0, "w_sum": 0.0, "w_min": None,
+            "escalations": 0, "deescalations": 0, "level": None,
+            "rule": None,
+        }
+        self._attack_adapt = {"events": 0, "last_mag": None}
         # Optional streaming sink (a JsonlExporter): every record is
         # written as it is recorded — crash-safe for the cluster roles,
         # whose exchange threads emit events the training loop never sees.
@@ -105,6 +137,21 @@ class MetricsHub:
             self.num_ranks = n
             self._observed = np.zeros(n, np.float64)
             self._excluded = np.zeros(n, np.float64)
+            self._observed_d = np.zeros(n, np.float64)
+            self._excluded_d = np.zeros(n, np.float64)
+
+    def _fold_exclusion(self, obs_inc, exc_inc):
+        """One exclusion observation into BOTH suspicion accumulators:
+        the cumulative arrays, and — with ``suspicion_halflife`` — the
+        exponentially decayed window twins (every feeder: taps, async
+        staleness deficits, hierarchical per-client audits)."""
+        self._observed += obs_inc
+        self._excluded += exc_inc
+        if self._halflife is not None:
+            self._observed_d *= self._susp_decay
+            self._excluded_d *= self._susp_decay
+            self._observed_d += obs_inc
+            self._excluded_d += exc_inc
 
     def record_step(self, step, *, loss=None, tap=None, step_time_s=None,
                     extra=None):
@@ -127,11 +174,12 @@ class MetricsHub:
             if tap_host is not None:
                 obs, sel = tap_host["observed"], tap_host["selected"]
                 self._ensure_ranks(obs.size)
-                self._observed += obs
                 # A rank's per-step exclusion is the influence the rule
                 # refused it, bounded by how much of it was observed at
                 # all (multi-observer bundles report fractions of both).
-                self._excluded += np.maximum(obs - np.minimum(sel, obs), 0.0)
+                self._fold_exclusion(
+                    obs, np.maximum(obs - np.minimum(sel, obs), 0.0)
+                )
                 self._last_tau = tap_host["tau"]
                 self._last_clip_frac = tap_host["clip_frac"]
                 self._selected_hist.append(
@@ -203,11 +251,44 @@ class MetricsHub:
                     if self.num_ranks and ranks.max() < self.num_ranks:
                         self._ensure_ranks(self.num_ranks)
                         if ws.size == ranks.size:
-                            np.add.at(self._observed, ranks, 1.0)
+                            obs_inc = np.zeros_like(self._observed)
+                            exc_inc = np.zeros_like(self._excluded)
+                            np.add.at(obs_inc, ranks, 1.0)
                             np.add.at(
-                                self._excluded, ranks,
+                                exc_inc, ranks,
                                 np.clip(1.0 - ws, 0.0, 1.0),
                             )
+                            self._fold_exclusion(obs_inc, exc_inc)
+            elif kind == "defense_weights":
+                # Closed-loop defense (schema v7): one per-round
+                # suspicion-weight vector over the quorum — digested to
+                # rounds/min/mean for the summary (the raw event streams
+                # to the sink like everything else).
+                ws = np.asarray(fields.get("weights", ()), np.float64)
+                if ws.size:
+                    d = self._defense
+                    d["rounds"] += 1
+                    d["w_sum"] += float(ws.mean())
+                    wmin = float(ws.min())
+                    d["w_min"] = (
+                        wmin if d["w_min"] is None
+                        else min(d["w_min"], wmin)
+                    )
+            elif kind == "defense_escalate":
+                d = self._defense
+                if fields.get("direction") == "deescalate":
+                    d["deescalations"] += 1
+                else:
+                    d["escalations"] += 1
+                if fields.get("level") is not None:
+                    d["level"] = int(fields["level"])
+                if fields.get("rule") is not None:
+                    d["rule"] = str(fields["rule"])
+            elif kind == "attack_adapt":
+                a = self._attack_adapt
+                a["events"] += 1
+                if fields.get("magnitude") is not None:
+                    a["last_mag"] = float(fields["magnitude"])
             elif kind == "hier_exclusion":
                 # The hierarchical reducer's per-client audit (aggregators/
                 # hierarchy.py): observed/selected weight vectors over the
@@ -220,9 +301,9 @@ class MetricsHub:
                 if obs.size and sel.size == obs.size:
                     self._ensure_ranks(obs.size)
                     if obs.size == self._observed.size:
-                        self._observed += obs
-                        self._excluded += np.maximum(
-                            obs - np.minimum(sel, obs), 0.0)
+                        self._fold_exclusion(
+                            obs, np.maximum(obs - np.minimum(sel, obs), 0.0)
+                        )
             self._ring.append(rec)
             self._drain(rec)
             return rec
@@ -280,6 +361,58 @@ class MetricsHub:
             if self._observed is None:
                 return None
             return self._excluded / np.maximum(self._observed, 1e-9)
+
+    def suspicion_decayed(self):
+        """Per-rank exclusion frequency over the exponentially decayed
+        window (``suspicion_halflife``), falling back to the cumulative
+        score when no halflife was configured — what the closed-loop
+        defense and the report tool's straggler cross-check consume: a
+        rotation attack cannot launder THIS score by sitting honest
+        while its cumulative denominator grows. None before any tap."""
+        with self._lock:
+            if self._observed is None:
+                return None
+            if self._halflife is None:
+                return self._excluded / np.maximum(self._observed, 1e-9)
+            return self._excluded_d / np.maximum(self._observed_d, 1e-9)
+
+    def defense_stats(self):
+        """Suspicion-weight digest + escalation state of the closed-loop
+        defense (schema v7), or None when no defense event was folded."""
+        with self._lock:
+            d = self._defense
+            if (not d["rounds"] and not d["escalations"]
+                    and not d["deescalations"] and d["level"] is None):
+                return None
+            return {
+                "rounds": int(d["rounds"]),
+                "mean_w": (
+                    None if not d["rounds"]
+                    else round(d["w_sum"] / d["rounds"], 6)
+                ),
+                "min_w": (
+                    None if d["w_min"] is None else round(d["w_min"], 6)
+                ),
+                "escalations": int(d["escalations"]),
+                "deescalations": int(d["deescalations"]),
+                "level": d["level"],
+                "rule": d["rule"],
+            }
+
+    def attack_adapt_stats(self):
+        """Adaptive-attacker digest (schema v7), or None when no
+        ``attack_adapt`` event was folded (oblivious-attack runs)."""
+        with self._lock:
+            a = self._attack_adapt
+            if not a["events"]:
+                return None
+            return {
+                "events": int(a["events"]),
+                "last_magnitude": (
+                    None if a["last_mag"] is None
+                    else round(a["last_mag"], 6)
+                ),
+            }
 
     def selection_history(self, k=60):
         """Last k (step, selected-list) pairs — the demo's history panel."""
@@ -421,6 +554,11 @@ class MetricsHub:
     def summary(self):
         """The run-closing JSONL record: suspicion, counters, timings."""
         susp = self.suspicion()
+        susp_d = (
+            self.suspicion_decayed() if self._halflife is not None else None
+        )
+        defense = self.defense_stats()
+        adapt = self.attack_adapt_stats()
         stale = self.staleness_stats()
         autos = self.autoscale_stats()
         wire_planes = self.wire_plane_counters()
@@ -444,6 +582,18 @@ class MetricsHub:
                 suspicion=(
                     None if susp is None else np.round(susp, 6).tolist()
                 ),
+                # schema v7: the windowed score (None without a
+                # configured suspicion_halflife — v6 consumers see
+                # nothing new).
+                suspicion_decayed=(
+                    None if susp_d is None
+                    else np.round(susp_d, 6).tolist()
+                ),
+                suspicion_halflife=self._halflife,
+                # schema v7: closed-loop defense + adaptive-attacker
+                # digests (None on runs without those events).
+                defense=defense,
+                attack_adapt=adapt,
                 observed=(
                     None if self._observed is None
                     else np.round(self._observed, 3).tolist()

@@ -336,10 +336,17 @@ def _stragglers(roles, ref, offsets):
     """Per-worker publish lateness vs the reference round start, with
     the PS's suspicion score for the cross-check. Lateness for round i
     = (worker publish end, aligned) - (ref round broadcast start);
-    the straggler is the rank whose median lateness tops the table."""
+    the straggler is the rank whose median lateness tops the table.
+    The cross-check prefers the WINDOWED (halflife-decayed) suspicion
+    when the run recorded one (schema v7): a straggler is a live
+    condition, and the cumulative score dilutes it with every clean
+    round since — exactly the laundering a rotated Byzantine cohort
+    exploits (DESIGN.md §16)."""
     bcast = _phase_times(roles[ref]["spans"], "broadcast")
     summary = roles[ref]["summary"] or {}
-    suspicion = summary.get("suspicion") or []
+    suspicion = (
+        summary.get("suspicion_decayed") or summary.get("suspicion") or []
+    )
     rows = []
     workers = [n for n in sorted(roles) if "worker" in n]
     for name in workers:

@@ -7,7 +7,11 @@ half-way in a later analysis session. This walks the repo root for
 committed fixture stream under ``tests/fixtures/``, and runs
 ``telemetry.exporters.validate_jsonl`` over each — wired into tier-1 by
 ``tests/test_trace.py::TestValidateArtifacts`` so schema drift in a
-future round fails the suite.
+future round fails the suite. Covers every registered record kind,
+including the schema-v7 ``defense_bench`` rows (DEFBENCH_r*: the
+adaptive-attack / closed-loop-defense accuracy cells) and the v7
+event/summary additions (attack_adapt, defense_weights,
+defense_escalate, attack_fallback, suspicion_decayed).
 
   python scripts/validate_artifacts.py            # repo root auto-found
   python scripts/validate_artifacts.py /some/repo

@@ -78,7 +78,8 @@ _RUN_LAST = {
     "test_hierarchy_stream.py": 2,
     "test_cluster.py": 3,
     "test_async_cluster.py": 4,
-    "test_apps.py": 5,
+    "test_defense_cluster.py": 5,
+    "test_apps.py": 6,
 }
 
 # Tier-1 wall-clock budget of the verify command (ROADMAP.md): the

@@ -1,0 +1,362 @@
+"""Adaptive (suspicion-aware) attack controllers: fast tier-1 coverage.
+
+The controller laws of ``attacks/adaptive.py`` (DESIGN.md §16) at unit
+scale — bisection convergence/re-expansion, rotation determinism, the
+model-delta probe — plus the in-graph trainer integration on the 8-device
+CPU mesh: the traced-magnitude fold path must train IDENTICALLY to the
+flat where-path, bursts must key on the staleness emulation's degradation
+windows, and oblivious configs must not grow any adaptive state (the
+purity half of the acceptance). The host-plane controller's multi-process
+twin lives in tests/test_defense_cluster.py (slow).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from garfield_tpu import data as data_lib
+from garfield_tpu.attacks import (
+    adaptive,
+    plan_gradient_attack_fold,
+    reset_attack_fallback,
+)
+from garfield_tpu.models import select_model
+from garfield_tpu.parallel import aggregathor
+from garfield_tpu.telemetry import hub as hub_lib
+from garfield_tpu.utils import selectors
+
+
+class TestBracket:
+    def _converge(self, theta, *, mag_min=0.25, mag_max=6.0, rounds=40):
+        lo, hi = mag_min, mag_max
+        for _ in range(rounds):
+            z = adaptive.played_magnitude(lo, hi)
+            lo, hi = (float(v) for v in adaptive.update_bracket(
+                lo, hi, z > theta, mag_min=mag_min, mag_max=mag_max,
+            ))
+        return lo, hi
+
+    def test_bisection_tracks_threshold(self):
+        # The played magnitude settles within a tenth of the bracket of
+        # the exclusion threshold, from either side.
+        for theta in (0.8, 2.7, 4.9):
+            lo, hi = self._converge(theta)
+            z = adaptive.played_magnitude(lo, hi)
+            assert abs(z - theta) < 0.1 * (6.0 - 0.25), (theta, lo, hi)
+
+    def test_always_accepted_regrows_to_max(self):
+        # A threshold above the bracket: acceptance + collapse-regrow
+        # must drive the play to mag_max, not freeze mid-bracket.
+        lo, hi = self._converge(100.0, rounds=60)
+        assert adaptive.played_magnitude(lo, hi) > 5.9
+
+    def test_always_detected_collapses_to_min(self):
+        lo, hi = self._converge(0.0, rounds=60)
+        assert adaptive.played_magnitude(lo, hi) < 0.3
+
+    def test_reexpansion_recovers_after_threshold_shift(self):
+        # The defense escalates mid-run: the threshold drops, the bracket
+        # re-closes below it; the defense relaxes, the regrow re-opens.
+        lo, hi = self._converge(4.0)
+        lo, hi = self._converge_from(lo, hi, 1.5)
+        z = adaptive.played_magnitude(lo, hi)
+        assert abs(z - 1.5) < 0.6, (lo, hi)
+
+    def _converge_from(self, lo, hi, theta, rounds=40):
+        for _ in range(rounds):
+            z = adaptive.played_magnitude(lo, hi)
+            lo, hi = (float(v) for v in adaptive.update_bracket(
+                lo, hi, z > theta, mag_min=0.25, mag_max=6.0,
+            ))
+        return lo, hi
+
+    def test_jnp_matches_host_law(self):
+        lo = hi = None
+        lo_j = jnp.float32(0.25)
+        hi_j = jnp.float32(6.0)
+        lo, hi = 0.25, 6.0
+        for det in (True, False, False, True, False):
+            lo, hi = (float(v) for v in adaptive.update_bracket(
+                lo, hi, det, mag_min=0.25, mag_max=6.0,
+            ))
+            lo_j, hi_j = adaptive.update_bracket(
+                lo_j, hi_j, jnp.asarray(det), mag_min=0.25, mag_max=6.0,
+            )
+            assert float(lo_j) == pytest.approx(lo, abs=1e-6)
+            assert float(hi_j) == pytest.approx(hi, abs=1e-6)
+
+
+class TestRotation:
+    def test_schedule_covers_pool_and_is_deterministic(self):
+        cfg = adaptive.configure(
+            "adaptive-lie", {"f_pool": 5, "rotation": 3},
+            num_workers=11, f=2,
+        )
+        seen = set()
+        for r in range(30):
+            m1 = adaptive.active_cohort(cfg, r)
+            m2 = adaptive.active_cohort(cfg, r)  # colluders agree
+            assert (m1 == m2).all()
+            assert m1.sum() == 2
+            assert set(np.flatnonzero(m1)) <= set(cfg.pool)
+            seen |= set(np.flatnonzero(m1))
+        assert seen == set(cfg.pool)  # every member takes a turn
+
+    def test_traced_mask_matches_host_schedule(self):
+        cfg = adaptive.configure(
+            "adaptive-lie", {"f_pool": 4, "rotation": 2},
+            num_workers=8, f=2,
+        )
+        fn = jax.jit(lambda s: adaptive.active_mask_traced(cfg, s))
+        for r in (0, 1, 2, 5, 9, 17):
+            np.testing.assert_array_equal(
+                np.asarray(fn(jnp.asarray(r, jnp.int32))),
+                adaptive.active_cohort(cfg, r),
+            )
+
+    def test_static_cohort_without_rotation(self):
+        cfg = adaptive.configure(
+            "adaptive-lie", {}, num_workers=8, f=2,
+        )
+        for r in (0, 7):
+            np.testing.assert_array_equal(
+                adaptive.active_cohort(cfg, r),
+                np.arange(8) >= 6,
+            )
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="f_pool"):
+            adaptive.configure(
+                "adaptive-lie", {"f_pool": 1}, num_workers=8, f=2
+            )
+        with pytest.raises(ValueError, match="adaptive"):
+            adaptive.configure("lie", {}, num_workers=8, f=2)
+        with pytest.raises(ValueError, match="mag_min"):
+            adaptive.configure(
+                "adaptive-lie", {"mag_min": 5.0, "mag_max": 1.0},
+                num_workers=8, f=2,
+            )
+
+
+class TestHostController:
+    def test_burst_triggers_on_gap_blowout_and_expires(self):
+        cfg = adaptive.configure(
+            "adaptive-lie", {"burst": 5.5}, num_workers=8, f=1,
+        )
+        c = adaptive.HostController(
+            cfg, 7, burst_factor=3.0, burst_rounds=2
+        )
+        t = 0.0
+        for _ in range(6):  # steady cadence: no burst
+            t += 0.1
+            assert not c.observe_round(t)
+        assert not c.bursting()
+        t += 1.0  # 10x gap: degradation window
+        assert c.observe_round(t)
+        assert c.bursting()
+        assert c.magnitude() == pytest.approx(5.5)
+        lo, hi = c.lo, c.hi
+        c.feedback(True)  # burst rounds are not bracket probes
+        assert (c.lo, c.hi) == (lo, hi)
+        c.feedback(False)
+        assert not c.bursting()  # expired after burst_rounds feedbacks
+
+    def test_delta_probe_separates_admitted_from_excluded(self):
+        rng = np.random.default_rng(0)
+        mu = rng.standard_normal(512)
+        sigma = np.abs(rng.standard_normal(512)) * 0.1
+        u = 2.0 * sigma
+        lr = 0.1
+        prev = rng.standard_normal(512)
+        for alpha, want_detected in ((0.0, True), (0.2, False)):
+            new = prev - lr * (mu + alpha * u)
+            det, score = adaptive.delta_probe(prev, new, u, mu_est=mu)
+            assert det is want_detected, (alpha, score)
+
+    def test_read_selected_tail(self, tmp_path):
+        import json
+
+        path = tmp_path / "ps.telemetry.jsonl"
+        recs = [
+            {"kind": "run", "meta": {}},
+            {"kind": "step", "step": 3,
+             "tap": {"selected": [1.0, 0.0, 1.0]}},
+            {"kind": "step", "step": 4,
+             "tap": {"selected": [1.0, 1.0, 0.0]}},
+        ]
+        path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        assert adaptive.read_selected(str(path), 2) == (4, 0.0)
+        assert adaptive.read_selected(str(path), 1) == (4, 1.0)
+        assert adaptive.read_selected(str(path), 9) is None
+
+
+def _pima_setup(lr=0.05):
+    module = select_model("pimanet", "pima")
+    loss = selectors.select_loss("bce")
+    opt = selectors.select_optimizer(
+        "sgd", lr=lr, momentum=0.0, weight_decay=0.0
+    )
+    return module, loss, opt
+
+
+def _pima_batches(n, bsz):
+    m = data_lib.DatasetManager("pima", bsz, n, n, 0)
+    m.num_ps = 0
+    xs, ys = m.sharded_train_batches()
+    return xs, jnp.asarray(xs[:, 0]), jnp.asarray(ys[:, 0])
+
+
+def _flat_params(state):
+    return np.concatenate(
+        [np.asarray(l).ravel() for l in jax.tree.leaves(state.params)]
+    )
+
+
+class TestTrainerIntegration:
+    def test_fold_path_matches_flat_path(self):
+        # The traced-magnitude fold plan (Gram fast path) must train
+        # identically to the flat where-path — the adaptive twin of the
+        # weighted fold-vs-flat pin in test_staleness.py.
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        states = []
+        for tree_path in (True, False):
+            init_fn, step_fn, _ = aggregathor.make_trainer(
+                module, loss, opt, "krum", num_workers=8, f=2,
+                attack="adaptive-lie", attack_params={"mag_max": 4.0},
+                tree_path=tree_path,
+            )
+            state = init_fn(jax.random.PRNGKey(1), xs[0, 0])
+            for _ in range(6):
+                state, metrics = step_fn(state, x, y)
+            assert np.isfinite(float(metrics["loss"]))
+            states.append((
+                _flat_params(state),
+                float(state.attack_state["lo"]),
+                float(state.attack_state["hi"]),
+            ))
+        np.testing.assert_allclose(
+            states[0][0], states[1][0], rtol=2e-5, atol=1e-6
+        )
+        # Same feedback -> same bracket trajectory on both paths.
+        assert states[0][1] == pytest.approx(states[1][1], abs=1e-5)
+        assert states[0][2] == pytest.approx(states[1][2], abs=1e-5)
+
+    def test_bracket_descends_under_detection(self):
+        # krum's exclusion threshold is finite: starting from a wide
+        # bracket, detections must pull hi below mag_max within a few
+        # steps, and the played magnitude must stay inside the bracket.
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = aggregathor.make_trainer(
+            module, loss, opt, "krum", num_workers=8, f=2,
+            attack="adaptive-lie", attack_params={"mag_max": 6.0},
+        )
+        state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+        mags = []
+        for _ in range(10):
+            state, metrics = step_fn(state, x, y)
+            mags.append(float(metrics["attack_mag"]))
+        assert float(state.attack_state["hi"]) < 6.0
+        assert all(0.25 <= m <= 6.0 for m in mags)
+
+    def test_rotation_runs_on_where_path(self):
+        # f_pool > f with rotation gates the fold off (dynamic remap);
+        # the run must still train and carry the bracket.
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = aggregathor.make_trainer(
+            module, loss, opt, "krum", num_workers=8, f=2,
+            attack="adaptive-lie",
+            attack_params={"f_pool": 4, "rotation": 2},
+        )
+        state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+        for _ in range(6):
+            state, metrics = step_fn(state, x, y)
+        assert np.isfinite(float(metrics["loss"]))
+        assert state.attack_state is not None
+
+    def test_burst_keys_on_staleness_degradation(self):
+        # A staleness schedule that hard-cuts an HONEST rank every round
+        # is a permanent degradation window: the attacker must play the
+        # burst magnitude and hold its bracket (bursts are not probes).
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = aggregathor.make_trainer(
+            module, loss, opt, "krum", num_workers=8, f=2,
+            attack="adaptive-lie",
+            attack_params={"mag_max": 4.0, "burst": 3.75},
+            staleness={
+                "max_staleness": 2, "decay": 0.5,
+                "taus": [0, 0, 0, 9, 0, 0, 0, 0],  # honest rank 3 cut
+            },
+        )
+        state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+        for _ in range(4):
+            state, metrics = step_fn(state, x, y)
+            assert float(metrics["attack_mag"]) == pytest.approx(3.75)
+        # Bracket held: every round was a burst, never a probe.
+        assert float(state.attack_state["lo"]) == pytest.approx(0.25)
+        assert float(state.attack_state["hi"]) == pytest.approx(4.0)
+
+    def test_oblivious_attacks_grow_no_adaptive_state(self):
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = aggregathor.make_trainer(
+            module, loss, opt, "krum", num_workers=8, f=2, attack="lie",
+        )
+        state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+        state, _ = step_fn(state, x, y)
+        assert state.attack_state is None
+        assert state.defense_state is None
+
+    def test_adaptive_rejects_explicit_mask_and_layer_granularity(self):
+        module, loss, opt = _pima_setup()
+        with pytest.raises(ValueError, match="byz_mask"):
+            aggregathor.make_trainer(
+                module, loss, opt, "krum", num_workers=8, f=2,
+                attack="adaptive-lie",
+                byz_mask=np.arange(8) >= 6,
+            )
+        with pytest.raises(ValueError, match="granularity"):
+            aggregathor.make_trainer(
+                module, loss, opt, "krum", num_workers=8, f=2,
+                attack="adaptive-lie", granularity="layer",
+            )
+
+
+class TestAttackFallbackEvent:
+    def test_randomized_fold_fallback_emits_once(self):
+        reset_attack_fallback()
+        hub = hub_lib.MetricsHub(num_ranks=4)
+        prev = hub_lib.install(hub)
+        try:
+            mask = np.array([False, False, True, True])
+            assert plan_gradient_attack_fold("random", mask) is None
+            assert plan_gradient_attack_fold("random", mask) is None
+            events = [
+                r for r in hub.records()
+                if r.get("event") == "attack_fallback"
+            ]
+            assert len(events) == 1
+            assert events[0]["attack"] == "random"
+            assert events[0]["path"] == "where"
+        finally:
+            hub_lib.install(prev)
+            reset_attack_fallback()
+
+    def test_deterministic_attacks_emit_nothing(self):
+        reset_attack_fallback()
+        hub = hub_lib.MetricsHub(num_ranks=4)
+        prev = hub_lib.install(hub)
+        try:
+            mask = np.array([False, False, True, True])
+            assert plan_gradient_attack_fold("lie", mask) is not None
+            assert not [
+                r for r in hub.records()
+                if r.get("event") == "attack_fallback"
+            ]
+        finally:
+            hub_lib.install(prev)
+            reset_attack_fallback()

@@ -1,0 +1,357 @@
+"""Closed-loop defense (aggregators/defense.py): fast tier-1 coverage.
+
+The suspicion-weight law (exact identity on clean/uniform histories, the
+median-relative inversion guard), the concentration statistic (both
+Byzantine signatures), the escalation state machine's HYSTERESIS — no
+flapping on a boundary value, the satellite pin — and the in-graph
+trainer integration: suspicion-weighted folds train fold-vs-flat
+equivalent, and defense-off trajectories are bitwise the undefended
+ones. The windowed hub suspicion (suspicion_halflife) is covered here
+too — it is what the rotation attack launders the cumulative score
+against.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from garfield_tpu import data as data_lib
+from garfield_tpu.aggregators import defense
+from garfield_tpu.models import select_model
+from garfield_tpu.parallel import aggregathor
+from garfield_tpu.telemetry import exporters as tele_fmt, hub as hub_lib
+from garfield_tpu.utils import selectors
+
+
+class TestWeights:
+    def test_clean_history_is_exactly_one(self):
+        w = defense.suspicion_weights(np.zeros(8, np.float32))
+        np.testing.assert_array_equal(w, np.ones(8, np.float32))
+
+    def test_uniform_history_is_exactly_one(self):
+        # The inversion guard: krum at m of n refuses n - m rows EVERY
+        # round; a uniformly-excluded crowd must not be down-weighted.
+        w = defense.suspicion_weights(np.full(8, 0.6, np.float32))
+        np.testing.assert_array_equal(w, np.ones(8, np.float32))
+
+    def test_relative_excess_is_punished_with_floor(self):
+        s = np.array([0.3, 0.3, 0.3, 1.0], np.float32)
+        w = defense.suspicion_weights(s, power=2.0, floor=0.1)
+        np.testing.assert_array_equal(w[:3], np.ones(3, np.float32))
+        assert w[3] == pytest.approx(max((1 - 0.7) ** 2, 0.1))
+
+    def test_raw_mode_and_validation(self):
+        w = defense.suspicion_weights(
+            np.array([0.0, 0.5]), relative=False, power=1.0, floor=0.0
+        )
+        np.testing.assert_allclose(w, [1.0, 0.5])
+        with pytest.raises(ValueError):
+            defense.suspicion_weights([0.1], floor=2.0)
+        with pytest.raises(ValueError):
+            defense.suspicion_weights([0.1], power=0.0)
+
+    def test_jnp_matches_np(self):
+        s = np.array([0.1, 0.9, 0.4, 0.4], np.float32)
+        w_np = defense.suspicion_weights(s)
+        w_j = np.asarray(defense.suspicion_weights(jnp.asarray(s)))
+        np.testing.assert_allclose(w_j, w_np, atol=1e-7)
+
+
+class TestConcentration:
+    def test_clean_is_zero_and_signatures_are_high(self):
+        assert defense.suspicion_concentration(np.zeros(8), 2) == 0.0
+        # Pinned victims (static attack): top-f -> 1, crowd low.
+        pinned = np.array([0.2] * 6 + [1.0, 1.0])
+        assert defense.suspicion_concentration(pinned, 2) >= 0.7
+        # Laundering cohort (adaptive attack): bottom-f conspicuously
+        # clean while the crowd absorbs the displaced exclusions.
+        laundered = np.array([0.05, 0.05] + [0.7] * 6)
+        assert defense.suspicion_concentration(laundered, 2) >= 0.6
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            defense.suspicion_concentration(np.zeros(4), 0)
+        with pytest.raises(ValueError):
+            defense.suspicion_concentration(np.zeros(4), 4)
+
+
+class TestEscalationPolicy:
+    def _policy(self, **kw):
+        cfg = dict(theta_up=0.5, theta_down=0.2, patience=3,
+                   clean_window=4)
+        cfg.update(kw)
+        return defense.EscalationPolicy(defense.EscalationConfig(**cfg))
+
+    def test_patience_gates_escalation(self):
+        p = self._policy()
+        assert p.observe(0.9) == 0
+        assert p.observe(0.9) == 0
+        assert p.observe(0.9) == 1
+        assert p.level_name == "multi-krum"
+
+    def test_boundary_value_never_flaps(self):
+        # The satellite pin: a concentration parked INSIDE the
+        # hysteresis band — or oscillating across it — moves nothing.
+        p = self._policy()
+        for _ in range(200):
+            assert p.observe(0.35) == 0
+        assert p.level == 0
+        p2 = self._policy()
+        for _ in range(100):
+            assert p2.observe(0.49) == 0   # just under theta_up
+            assert p2.observe(0.21) == 0   # just over theta_down
+        assert p2.level == 0 and p2.escalations == 0
+
+    def test_interruption_resets_counters(self):
+        p = self._policy()
+        p.observe(0.9)
+        p.observe(0.9)
+        p.observe(0.35)  # band: resets the hot streak
+        assert p.observe(0.9) == 0
+        assert p.observe(0.9) == 0
+        assert p.observe(0.9) == 1
+
+    def test_clean_window_deescalates_and_floors_at_zero(self):
+        p = self._policy(patience=1, clean_window=3)
+        assert p.observe(0.9) == 1
+        for _ in range(2):
+            assert p.observe(0.1) == 0
+        assert p.observe(0.1) == -1
+        assert p.level == 0
+        for _ in range(10):  # never below the ladder's base
+            p.observe(0.1)
+        assert p.level == 0
+
+    def test_ladder_tops_out(self):
+        p = self._policy(patience=1)
+        assert p.observe(0.9) == 1
+        assert p.observe(0.9) == 1
+        assert p.level_name == "bulyan"
+        for _ in range(5):
+            assert p.observe(0.9) == 0  # saturated
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="theta"):
+            defense.EscalationConfig(theta_up=0.2, theta_down=0.3)
+        with pytest.raises(ValueError, match="unknown escalation level"):
+            defense.EscalationConfig(levels=("krum", "nope"))
+        with pytest.raises(ValueError, match="stateful"):
+            defense.EscalationConfig(levels=("krum", "cclip"))
+
+    def test_resolve_cli(self):
+        class A:
+            defense = "escalate"
+            defense_params = {"theta_up": 0.6, "halflife": 8}
+
+        plan = defense.resolve(A())
+        assert plan.escalate and plan.weighted
+        assert plan.halflife == 8.0
+        assert plan.policy().config.theta_up == 0.6
+
+        class B:
+            defense = None
+
+        assert defense.resolve(B()) is None
+
+        class C:
+            defense = "weighted"
+            defense_params = {"bogus": 1}
+
+        with pytest.raises(SystemExit, match="bogus"):
+            defense.resolve(C())
+
+
+class TestHubWindowedSuspicion:
+    def _tap(self, selected):
+        n = len(selected)
+        return {
+            "observed": np.ones(n), "selected": np.array(selected),
+            "score": np.zeros(n), "tau": 0.0, "clip_frac": 0.0,
+        }
+
+    def test_decayed_score_forgets_old_attacks(self):
+        # Rank 3 attacks for 10 steps, then sits honest for 40: the
+        # cumulative score dilutes slowly, the windowed score collapses
+        # — the laundering detector (DESIGN.md §16).
+        hub = hub_lib.MetricsHub(num_ranks=4, suspicion_halflife=5)
+        for i in range(10):
+            hub.record_step(i, tap=self._tap([1, 1, 1, 0]))
+        for i in range(10, 50):
+            hub.record_step(i, tap=self._tap([1, 1, 1, 1]))
+        cum = hub.suspicion()
+        dec = hub.suspicion_decayed()
+        assert cum[3] == pytest.approx(10 / 50)
+        assert dec[3] < 0.01 < cum[3]
+
+    def test_decayed_score_sees_live_attacks(self):
+        hub = hub_lib.MetricsHub(num_ranks=4, suspicion_halflife=5)
+        for i in range(40):
+            hub.record_step(i, tap=self._tap([1, 1, 1, 1]))
+        for i in range(40, 50):
+            hub.record_step(i, tap=self._tap([1, 1, 1, 0]))
+        assert hub.suspicion()[3] == pytest.approx(10 / 50)
+        assert hub.suspicion_decayed()[3] > 0.6
+
+    def test_no_halflife_falls_back_to_cumulative(self):
+        hub = hub_lib.MetricsHub(num_ranks=2)
+        hub.record_step(0, tap=self._tap([1, 0]))
+        np.testing.assert_allclose(
+            hub.suspicion_decayed(), hub.suspicion()
+        )
+
+    def test_summary_and_events_validate_as_v7(self):
+        hub = hub_lib.MetricsHub(num_ranks=3, suspicion_halflife=4)
+        hub.record_step(0, tap=self._tap([1, 1, 0]))
+        recs = [
+            hub.record_event("attack_adapt", step=0, magnitude=1.5,
+                             detected=True, lo=0.25, hi=3.0),
+            hub.record_event("defense_weights", step=0,
+                             ranks=[0, 1, 2], weights=[1.0, 1.0, 0.1]),
+            hub.record_event("defense_escalate", step=1, level=1,
+                             rule="multi-krum", direction="escalate"),
+            hub.record_event("attack_fallback", attack="random",
+                             path="where", why="randomized"),
+            hub.summary(),
+        ]
+        for r in recs:
+            tele_fmt.validate_record(r)
+        s = recs[-1]
+        assert s["suspicion_decayed"] is not None
+        assert s["defense"]["escalations"] == 1
+        assert s["defense"]["rule"] == "multi-krum"
+        assert s["defense"]["min_w"] == pytest.approx(0.1)
+        assert s["attack_adapt"]["events"] == 1
+
+    def test_malformed_v7_events_rejected(self):
+        for rec in (
+            tele_fmt.make_record("event", event="attack_adapt",
+                                 magnitude="big"),
+            tele_fmt.make_record("event", event="defense_escalate",
+                                 level=-1, rule="krum",
+                                 direction="escalate"),
+            tele_fmt.make_record("event", event="defense_escalate",
+                                 level=1, rule="krum", direction="up"),
+            tele_fmt.make_record("event", event="defense_weights",
+                                 weights="all"),
+            tele_fmt.make_record("defense_bench", cell="", gar="krum"),
+            tele_fmt.make_record("defense_bench", cell="c", gar="krum",
+                                 final_accuracy="high"),
+        ):
+            with pytest.raises(ValueError):
+                tele_fmt.validate_record(rec)
+
+
+def _pima_setup():
+    module = select_model("pimanet", "pima")
+    loss = selectors.select_loss("bce")
+    opt = selectors.select_optimizer(
+        "sgd", lr=0.05, momentum=0.0, weight_decay=0.0
+    )
+    return module, loss, opt
+
+
+def _pima_batches(n, bsz):
+    m = data_lib.DatasetManager("pima", bsz, n, n, 0)
+    m.num_ps = 0
+    xs, ys = m.sharded_train_batches()
+    return xs, jnp.asarray(xs[:, 0]), jnp.asarray(ys[:, 0])
+
+
+def _flat_params(state):
+    return np.concatenate(
+        [np.asarray(l).ravel() for l in jax.tree.leaves(state.params)]
+    )
+
+
+class TestTrainerIntegration:
+    def test_defense_off_is_bitwise_undefended(self):
+        # The acceptance's purity half: defense=None must not change one
+        # bit of the trajectory (nothing defense-shaped is traced).
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        runs = []
+        for d in (None, None):
+            init_fn, step_fn, _ = aggregathor.make_trainer(
+                module, loss, opt, "krum", num_workers=8, f=2,
+                attack="lie", defense=d,
+            )
+            state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+            for _ in range(5):
+                state, metrics = step_fn(state, x, y)
+            runs.append((_flat_params(state), float(metrics["loss"])))
+        np.testing.assert_array_equal(runs[0][0], runs[1][0])
+        assert runs[0][1] == runs[1][1]
+
+    def test_suspicion_weighted_fold_matches_flat(self):
+        # The acceptance pin: suspicion-weighted folds (Gram row-weight
+        # composition) train equivalently to the flat path's explicit
+        # row scaling — with the SAME carried defense EMA on both.
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        outs = []
+        for tree_path in (True, False):
+            init_fn, step_fn, _ = aggregathor.make_trainer(
+                module, loss, opt, "krum", num_workers=8, f=2,
+                attack="lie", defense={"halflife": 4.0},
+                tree_path=tree_path,
+            )
+            state = init_fn(jax.random.PRNGKey(2), xs[0, 0])
+            for _ in range(6):
+                state, metrics = step_fn(state, x, y)
+            assert np.isfinite(float(metrics["loss"]))
+            outs.append((
+                _flat_params(state),
+                np.asarray(state.defense_state["exc"]),
+            ))
+        np.testing.assert_allclose(
+            outs[0][0], outs[1][0], rtol=2e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(outs[0][1], outs[1][1], atol=1e-4)
+
+    def test_defense_state_accumulates_exclusions(self):
+        module, loss, opt = _pima_setup()
+        m = data_lib.DatasetManager("pima", 16, 8, 8, 0)
+        m.num_ps = 0
+        xs, ys = m.sharded_train_batches()
+        init_fn, step_fn, _ = aggregathor.make_trainer(
+            module, loss, opt, "krum", num_workers=8, f=2,
+            attack="reverse", defense={"halflife": 8.0},
+        )
+        state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+        nb = xs.shape[1]
+        for i in range(12):
+            # Fresh batches: a FIXED batch would pin krum's exclusion
+            # pattern among the honest ranks too (deterministic
+            # geometry), which is not what the defense keys on.
+            b = i % nb
+            state, metrics = step_fn(
+                state, jnp.asarray(xs[:, b]), jnp.asarray(ys[:, b])
+            )
+        obs = np.asarray(state.defense_state["obs"])
+        exc = np.asarray(state.defense_state["exc"])
+        assert (obs > 0).all()
+        # reverse (-100x) rows are excluded every round: the Byzantine
+        # ranks' exclusion EMA must dominate the honest ranks'.
+        susp = exc / obs
+        assert susp[6:].min() > susp[:6].max()
+        # And the median-relative weights floor the Byzantine ranks while
+        # every honest rank keeps (clearly) more weight than any of them.
+        w = np.asarray(defense.suspicion_weights(jnp.asarray(susp)))
+        assert w[6:].max() <= 0.2
+        assert w[:6].min() > 2 * w[6:].max()
+
+    def test_defense_composes_with_staleness(self):
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = aggregathor.make_trainer(
+            module, loss, opt, "krum", num_workers=8, f=2,
+            attack="lie", defense={"halflife": 8.0},
+            staleness={"max_staleness": 3, "decay": 0.5,
+                       "taus": [0, 1, 0, 2, 0, 0, 0, 3]},
+        )
+        state = init_fn(jax.random.PRNGKey(1), xs[0, 0])
+        for _ in range(5):
+            state, metrics = step_fn(state, x, y)
+        assert np.isfinite(float(metrics["loss"]))
+        assert metrics["defense_w"].shape == (8,)

@@ -1,0 +1,178 @@
+"""Adaptive adversary vs closed-loop defense, end to end (slow).
+
+The multi-process twin of tests/test_adaptive.py / test_defense.py
+(DESIGN.md §16): a REAL suspicion-aware Byzantine worker process
+(``--attack adaptive-lie`` — bisection magnitude fed by the broadcast
+model delta) against an SSMW PS running ``--defense escalate``
+(suspicion-weighted quorums + the rule ladder) with the windowed
+suspicion score, over PeerExchange on localhost. Plus the on-mesh CLI
+closed loop (apps/common.py escalation rebuild) driven through
+app_aggregathor.main.
+
+Registered in conftest._RUN_LAST (multi-process e2e discipline): these
+spawn subprocess fleets and compile per process — minutes by design, so
+they are slow-marked and collect last.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ports(k):
+    socks = [socket.socket() for _ in range(k)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO
+    env["GARFIELD_SURROGATE_MARGIN"] = "30"
+    env["GARFIELD_SURROGATE_LABEL_NOISE"] = "0"
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    return env
+
+
+def test_adaptive_attacker_vs_escalating_ps(tmp_path):
+    """1 PS (--defense escalate, windowed suspicion) + 6 workers, one of
+    them a real adaptive-lie process: the deployment must finish with
+    every role rc 0, the attacker must have closed real probes through
+    the model-delta channel, and the PS summary must carry the schema-v7
+    defense digest."""
+    from garfield_tpu.utils import multihost
+
+    n_w = 6
+    pp = _ports(1 + n_w)
+    cfg_path = str(tmp_path / "cluster.json")
+    multihost.generate_config(
+        cfg_path,
+        ps=[f"127.0.0.1:{pp[0]}"],
+        workers=[f"127.0.0.1:{p}" for p in pp[1:]],
+        task_type="ps", task_index=0,
+    )
+    env = _env()
+    tele = str(tmp_path / "tele")
+    base = [
+        sys.executable, "-m", "garfield_tpu.apps.aggregathor",
+        "--cluster", cfg_path,
+        "--dataset", "pima", "--model", "pimanet", "--loss", "bce",
+        "--batch", "16", "--fw", "1", "--gar", "krum",
+        "--num_iter", "50", "--acc_freq", "10",
+        "--opt_args", '{"lr":"0.05"}',
+        "--cluster_timeout_ms", "120000",
+    ]
+    ps = subprocess.Popen(
+        base + ["--task", "ps:0", "--defense", "escalate",
+                "--defense_params",
+                '{"patience": 3, "theta_up": 0.35, "theta_down": 0.1}',
+                "--suspicion_halflife", "10", "--telemetry", tele],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    honest = [
+        subprocess.Popen(
+            base + ["--task", f"worker:{k}"], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+        for k in range(n_w - 1)
+    ]
+    attacker = subprocess.Popen(
+        base + ["--task", f"worker:{n_w - 1}", "--attack", "adaptive-lie",
+                "--attack_params", '{"mag_max": 4.0}',
+                "--telemetry", tele],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        out, _ = ps.communicate(timeout=600)
+        assert ps.returncode == 0, f"PS failed:\n{out[-2000:]}"
+        summary = json.loads(
+            [l for l in out.splitlines() if l.startswith("{")][-1]
+        )
+        assert summary["steps"] == 50
+        aout, _ = attacker.communicate(timeout=180)
+        assert attacker.returncode == 0, f"attacker:\n{aout[-1500:]}"
+        asum = json.loads(
+            [l for l in aout.splitlines() if l.startswith("{")][-1]
+        )
+        # The controller closed real probes through the delta channel.
+        assert asum["attack_adapt"]["probes"] > 10
+        for w in honest:
+            w.wait(timeout=180)
+            assert w.returncode == 0
+    finally:
+        for p in [ps, attacker, *honest]:
+            if p.poll() is None:
+                p.kill()
+    # Schema-v7 plumbing landed in the PS stream: defense digest (the
+    # per-round suspicion weights were folded) + windowed suspicion.
+    recs = [
+        json.loads(l)
+        for l in open(os.path.join(tele, "cluster-ps.telemetry.jsonl"))
+    ]
+    summaries = [r for r in recs if r["kind"] == "summary"]
+    assert summaries, "PS wrote no summary"
+    s = summaries[-1]
+    assert s["defense"] is not None and s["defense"]["rounds"] > 0
+    assert s["suspicion_decayed"] is not None
+    assert any(r.get("event") == "defense_weights" for r in recs)
+    # The attacker's own stream carries its controller telemetry.
+    wrecs = [
+        json.loads(l) for l in open(os.path.join(
+            tele, f"cluster-worker-{n_w - 1}.telemetry.jsonl"
+        ))
+    ]
+    assert any(r.get("event") == "attack_adapt" for r in wrecs)
+
+
+def test_onmesh_cli_closed_loop(tmp_path):
+    """The on-mesh CLI loop: app_aggregathor under adaptive-lie with
+    --defense escalate must train, emit attack_adapt + defense_weights
+    events, and write a v7 summary with both digests."""
+    from garfield_tpu.apps import aggregathor as app_aggregathor
+
+    tele = str(tmp_path / "tele")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        app_aggregathor.main([
+            "--dataset", "pima", "--model", "pimanet", "--loss", "bce",
+            "--batch", "16", "--num_workers", "8", "--fw", "2",
+            "--gar", "krum", "--attack", "adaptive-lie",
+            "--attack_params", '{"mag_max": 4.0}',
+            "--defense", "escalate",
+            "--defense_params",
+            '{"patience": 3, "theta_up": 0.35, "theta_down": 0.1}',
+            "--suspicion_halflife", "12",
+            "--opt_args", '{"lr":"0.05"}',
+            "--num_iter", "40", "--acc_freq", "20",
+            "--telemetry", tele,
+        ])
+    finally:
+        os.chdir(cwd)
+    recs = [
+        json.loads(l)
+        for l in open(os.path.join(tele, "telemetry.jsonl"))
+    ]
+    assert any(r.get("event") == "attack_adapt" for r in recs)
+    assert any(r.get("event") == "defense_weights" for r in recs)
+    s = [r for r in recs if r["kind"] == "summary"][-1]
+    assert s["attack_adapt"]["events"] == 40
+    assert s["defense"] is not None and s["defense"]["rounds"] == 40
+    assert s["suspicion_decayed"] is not None

@@ -78,6 +78,82 @@ def test_average_is_broken_by_strong_attacks(attack):
     assert err_avg > 20 * 5 * SIGMA * np.sqrt(D)
 
 
+# --- adaptive rows (DESIGN.md §16) -----------------------------------------
+#
+# The stack-level closed loop: a bisection controller (attacks/adaptive.py)
+# plays the lie magnitude against the rule's actual admission each round —
+# feedback is the fraction of the fake's excess direction present in the
+# aggregate, the exact signal a real attacker probes from the broadcast
+# model delta. ``async`` composes the bounded-staleness discount weights
+# into the rows (utils/rounds.py), the same composition the async PS
+# applies.
+
+ADAPTIVE_RULES = ["krum", "bulyan", "hier-krum"]
+
+
+def _adaptive_lie_rounds(rule, mode, T=48):
+    from garfield_tpu.attacks import adaptive
+    from garfield_tpu.utils import rounds
+
+    cfg = adaptive.configure(
+        "adaptive-lie", {"mag_max": 6.0}, num_workers=N, f=F
+    )
+    lo, hi = cfg.mag_min, cfg.mag_max
+    rng = np.random.default_rng(zlib.crc32(f"{rule}-{mode}".encode()))
+    mu = np.ones(D, np.float32)
+    mask = jnp.arange(N) >= N - F
+    errs, max_admitted = [], 0.0
+    for _ in range(T):
+        honest = mu + SIGMA * rng.standard_normal((N, D)).astype(np.float32)
+        z = float(adaptive.played_magnitude(lo, hi))
+        attacked = apply_gradient_attack(
+            "lie", jnp.asarray(honest), mask, z=z
+        )
+        if mode == "async":
+            taus = np.zeros(N, np.int64)
+            taus[1] = 2  # one stale honest rank, discounted not dropped
+            w = rounds.staleness_weights(taus, decay=0.5, max_staleness=4)
+            attacked = attacked * jnp.asarray(w)[:, None]
+        agg = np.asarray(gars[rule].unchecked(attacked, f=F))
+        hm = honest[: N - F].mean(axis=0)
+        u = np.asarray(attacked[N - 1]) - hm  # the fake's excess direction
+        frac = float(np.dot(agg - hm, u) / max(np.dot(u, u), 1e-12))
+        detected = frac < 0.05
+        if not detected:
+            max_admitted = max(max_admitted, z)
+        lo, hi = (float(v) for v in adaptive.update_bracket(
+            lo, hi, detected, mag_min=cfg.mag_min, mag_max=cfg.mag_max,
+        ))
+        errs.append(float(np.linalg.norm(agg - mu)))
+    return errs, max_admitted, (lo, hi)
+
+
+@pytest.mark.parametrize("mode", ["sync", "async"])
+@pytest.mark.parametrize("rule", ADAPTIVE_RULES)
+def test_adaptive_lie_converges_and_stays_bounded(rule, mode):
+    """Both halves of the adaptive contract at stack level: the attacker
+    SUSTAINS a magnitude well above the static ALIE z without being
+    excluded (it measurably beats the oblivious attack), and the rule
+    still bounds the adapted aggregate within the matrix tolerance (the
+    reason escalating to a stronger rule restores the accuracy bar)."""
+    from garfield_tpu.attacks import LIE_Z
+
+    errs, max_admitted, (lo, hi) = _adaptive_lie_rounds(rule, mode)
+    tol = 5 * SIGMA * np.sqrt(D)
+    assert all(np.isfinite(errs)), f"{rule}/{mode}: non-finite aggregate"
+    assert max(errs) <= tol, (
+        f"{rule}/{mode}: adapted attack broke the bound "
+        f"({max(errs):.4f} > {tol:.4f})"
+    )
+    assert max_admitted > 1.2 * LIE_Z, (
+        f"{rule}/{mode}: controller only sustained z={max_admitted:.3f} "
+        f"(static ALIE is {LIE_Z})"
+    )
+    # Converged: the bracket closed far inside its initial width (the
+    # re-expansion keeps probing, so it never pinches to a point).
+    assert hi - lo < 2.0, f"{rule}/{mode}: bracket never converged"
+
+
 @pytest.mark.parametrize("rule", [r for r in RULES if r != "condense"])
 def test_permutation_invariant_under_attack(rule):
     """Shuffling worker rows must not change the aggregate (the mesh slot a
