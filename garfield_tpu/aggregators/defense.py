@@ -49,6 +49,7 @@ __all__ = [
     "suspicion_concentration",
     "EscalationConfig",
     "EscalationPolicy",
+    "PlaneDefense",
     "DefensePlan",
     "resolve",
 ]
@@ -79,6 +80,36 @@ def resolve_level(level):
         )
     name, params = LEVEL_RULES[level]
     return name, dict(params)
+
+
+def start_level(levels, gar_name, gar_params=None):
+    """The ladder level an escalating defense STARTS at for a configured
+    rule — matched by resolved SEMANTICS, never by name alone.
+
+    The repo's default ``krum`` (m = n - f - 2) IS the ``multi-krum``
+    level; starting the ladder at the name-matching ``krum`` level
+    (classic, m = 1) would silently DOWNGRADE the deployed rule — and
+    classic krum's single-select is categorically broken against a
+    duplicate-cluster collusion fake (the f identical rows hand each
+    other zero-distance neighbors, so one of them wins the score at
+    essentially any magnitude; a floored adaptive-empire then stalls
+    training from INSIDE the selection, DESIGN.md §17). An explicit
+    ``gar_params {"m": 1}`` still starts at the classic level. Rules
+    with no matching level start at 0 (the callers validate membership
+    in LEVEL_RULES separately)."""
+    m = dict(gar_params or {}).get("m")
+    fallback = None
+    for i, lv in enumerate(levels):
+        name, params = resolve_level(lv)
+        if name != gar_name:
+            continue
+        if params.get("m") == m:
+            return i
+        if fallback is None:
+            fallback = i
+    if fallback is not None:
+        return fallback
+    return 0
 
 
 def suspicion_weights(suspicion, *, power=2.0, floor=0.1, relative=True):
@@ -247,6 +278,114 @@ class EscalationPolicy:
             self.deescalations += 1
             return -1
         return 0
+
+
+class PlaneDefense:
+    """Host-side closed-loop defense state for ONE aggregation plane.
+
+    The SSMW PS derives its suspicion from its MetricsHub (it is the
+    deployment's audit point); the other host planes — the MSMW replicas'
+    gradient quorums, a LEARN node's gradient gather and model gossip —
+    each see their own rank-attributed quorums and need their own
+    independent history (DESIGN.md §17: "independent ladders per plane").
+    One ``PlaneDefense`` carries, for one plane:
+
+      - a decayed per-rank exclusion EMA (the MetricsHub windowed-
+        suspicion law: ``obs``/``exc`` twins multiplied by
+        ``0.5 ** (1/halflife)`` per fold — a rotation cannot launder it),
+      - the ``suspicion_weights`` map (median-relative, floored), and
+      - an optional per-plane ``EscalationPolicy`` whose ladder starts AT
+        the plane's configured rule when that rule is a ladder level.
+
+    ``fold(ranks, selected)`` ingests one round's audit: the quorum's
+    rank ids plus the rule's per-row selection weights over exactly those
+    rows (taps order). ``weights_for(ranks)`` returns the per-quorum-row
+    weight vector (all-1.0 on a clean history — the caller dispatches
+    the unweighted program then, preserving the bitwise contracts).
+    ``observe()`` folds the current concentration into the ladder and
+    returns the policy's action (0 when not escalating); the CALLER
+    validates feasibility at its quorum size and calls ``revert`` on an
+    infeasible level (the SSMW PS convention).
+    """
+
+    def __init__(self, plan, num_ranks, *, f, plane, base_gar,
+                 base_params=None):
+        self.plan = plan
+        self.num_ranks = int(num_ranks)
+        self.f = max(1, int(f))
+        self.plane = str(plane)
+        self.base_gar = base_gar
+        self.base_params = dict(base_params or {})
+        self._decay = 0.5 ** (1.0 / float(plan.halflife))
+        self._obs = np.zeros(self.num_ranks, np.float64)
+        self._exc = np.zeros(self.num_ranks, np.float64)
+        self.policy = plan.policy()
+        if self.policy is not None:
+            levels = self.policy.config.levels
+            if base_gar not in LEVEL_RULES:
+                raise ValueError(
+                    f"--defense escalate on the {self.plane!r} plane "
+                    f"needs its rule to name an escalation-ladder level "
+                    f"({sorted(LEVEL_RULES)}), got {base_gar!r}"
+                )
+            self.policy.level = start_level(
+                levels, base_gar, self.base_params
+            )
+
+    def fold(self, ranks, selected):
+        """One round's audit: ``ranks`` observed, ``selected`` the rule's
+        per-row influence over exactly those rows."""
+        ranks = np.asarray(ranks, np.int64)
+        sel = np.asarray(selected, np.float64)
+        obs_inc = np.zeros(self.num_ranks, np.float64)
+        exc_inc = np.zeros(self.num_ranks, np.float64)
+        np.add.at(obs_inc, ranks, 1.0)
+        np.add.at(exc_inc, ranks, (sel <= 0.0).astype(np.float64))
+        self._obs *= self._decay
+        self._exc *= self._decay
+        self._obs += obs_inc
+        self._exc += exc_inc
+
+    def suspicion(self):
+        return self._exc / np.maximum(self._obs, 1e-9)
+
+    def weights_full(self):
+        """(num_ranks,) suspicion weights — exactly 1.0 pre-history."""
+        return np.asarray(suspicion_weights(
+            self.suspicion(), power=self.plan.power, floor=self.plan.floor
+        ), np.float32)
+
+    def weights_for(self, ranks):
+        """Per-quorum-row weights for this round's rank composition, or
+        None when every weight is exactly 1.0 (dispatch the unweighted
+        program — the clean-history identity)."""
+        w = self.weights_full()[np.asarray(ranks, np.int64)]
+        if np.all(w == 1.0):
+            return None
+        return w.astype(np.float32)
+
+    def concentration(self):
+        return float(suspicion_concentration(self.suspicion(), self.f))
+
+    def observe(self):
+        """Fold this round's concentration into the per-plane ladder;
+        returns the policy action (always 0 without escalation)."""
+        if self.policy is None:
+            return 0
+        return self.policy.observe(self.concentration())
+
+    def revert(self, action):
+        """Undo an escalation the caller found infeasible at its quorum
+        size (bulyan needs q >= 4f + 3)."""
+        self.policy.level -= action
+
+    def current(self):
+        """(gar_name, gar_params) of the plane's active rule: the ladder
+        level when escalating, else the configured base rule."""
+        if self.policy is None:
+            return self.base_gar, dict(self.base_params)
+        name, lvl = resolve_level(self.policy.level_name)
+        return name, {**self.base_params, **lvl}
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,8 +1,27 @@
 """Adaptive-adversary vs closed-loop-defense record (DEFBENCH_r*).
 
-The committed acceptance artifact of DESIGN.md §16, measured as matched
-accuracy CELLS on the on-mesh aggregathor topology (same task, same
-seed, same step budget — only the attack/defense column changes):
+The committed acceptance artifact of DESIGN.md §16/§17, measured as
+matched accuracy CELLS (same task, same seed, same step budget — only
+the attack/defense column changes). r01 covered the gradient plane on
+the aggregathor topology; r02 (``--grid``) extends the record to the
+full PLANE x ATTACK x DEFENSE matrix:
+
+  - **gradient** (aggregathor): clean / static vs adaptive lie+empire /
+    the labelflip + backdoor TARGETED family (success measured as
+    source→target confusion and trigger ASR via ``parallel.
+    targeted_eval`` — the per-class metric the divergence-blind
+    suspicion plane cannot produce), each with defense off vs
+    ``escalate``;
+  - **model** (byzsgd): a Byzantine PS running the model-plane collusion
+    (``--ps_attack lie`` / ``adaptive-lie`` — mu + z*sigma over the
+    gathered replica stack) against the fps-tolerant gather, defended by
+    the per-plane suspicion weighting (``defense=`` on both planes) +
+    the gradient ladder;
+  - **gossip** (learn): Byzantine nodes poisoning the plane-2 model
+    gossip (``model_attack lie`` / ``adaptive-lie``) under per-node
+    wait-n-f subsets, same defense.
+
+Original r01 cells (kept; the ``main`` entry without ``--grid``):
 
   1. ``clean``              — no attack, vanilla krum: the accuracy bar.
   2. ``static-lie``         — the oblivious ALIE attack (z = 1.035).
@@ -45,14 +64,27 @@ import numpy as np
 
 from ... import data as data_lib, parallel
 from ...aggregators import defense as defense_lib
-from ...attacks import LIE_Z
+from ...attacks import LIE_Z, targeted as targeted_lib
 from ...models import select_model
-from ...parallel import aggregathor
+from ...parallel import aggregathor, byzsgd, learn
 from ...telemetry import exporters as tele_fmt, hub as hub_lib
 from ...utils import selectors
 
 N_WORKERS = 16
 F = 3  # bulyan (the ladder's top) needs n >= 4f + 3 = 15
+# Model-plane (byzsgd) grid geometry: enough replicas for honest
+# divergence under per-PS gradient subsets, fps = 1 Byzantine replica.
+N_PS, FPS = 5, 1
+# Gossip-plane (learn) grid geometry: 10 nodes with 3 Byzantine and a
+# wait-n-f subset of 9 — krum stays feasible (q >= 2f + 3) while the
+# nodes genuinely diverge AND the 3-row duplicate fake cluster has
+# enough mass inside a node's quorum to matter (measured: at f=2 of 8
+# the per-node rule rejects the whole collusion family outright and the
+# grid's gossip row degenerates to ties).
+N_NODES, F_NODES, NODE_SUBSET = 10, 3, 9
+# Model/gossip collusion bracket ceiling: the model planes' spread is
+# smaller than the gradient plane's, so the search space is wider.
+PLANE_MAG_MAX = 12.0
 
 
 def _task(args):
@@ -100,8 +132,9 @@ def run_cell(args, task, name, *, attack=None, attack_params=None,
             theta_up=args.theta_up, theta_down=args.theta_down,
             patience=args.patience, clean_window=args.clean_window,
         ))
-        if gar in policy.config.levels:
-            policy.level = policy.config.levels.index(gar)
+        policy.level = defense_lib.start_level(
+            policy.config.levels, gar, gar_params
+        )
         gar, gar_params = policy.current()
 
     def build(g, gp):
@@ -149,11 +182,29 @@ def run_cell(args, task, name, *, attack=None, attack_params=None,
                     _, step_fn, eval_fn = build(gar, gar_params)
     del x, y
     acc = parallel.compute_accuracy(state, eval_fn, test, binary=True)
+    # Targeted success metrics (schema v8): source→target confusion on
+    # EVERY gradient cell (the clean cell's value is the baseline the
+    # acceptance bar is 2x of), trigger ASR on backdoor cells.
+    tcfg = None
+    if targeted_lib.is_targeted(attack):
+        tcfg = targeted_lib.configure(attack, attack_params, num_classes=1)
+    trep = parallel.targeted_eval(
+        state, eval_fn, test,
+        source=(tcfg.source if tcfg else 0),
+        target=(tcfg.target if tcfg else 1),
+        trigger_cfg=(
+            tcfg if tcfg is not None and tcfg.attack == "backdoor"
+            else targeted_lib.TargetedConfig(
+                "backdoor", 0, 1, binary=True
+            ) if attack is None else None
+        ),
+    )
     susp = hub.suspicion()
     susp_d = hub.suspicion_decayed()
     rec = tele_fmt.make_record(
         "defense_bench",
         cell=name,
+        plane="gradient",
         gar=str(gar),
         attack=attack,
         defense="escalate" if defense else None,
@@ -164,6 +215,11 @@ def run_cell(args, task, name, *, attack=None, attack_params=None,
         attack_magnitude=(
             None if last_mag is None else round(last_mag, 6)
         ),
+        confusion=(
+            None if trep["confusion"] is None
+            else round(trep["confusion"], 6)
+        ),
+        asr=None if trep["asr"] is None else round(trep["asr"], 6),
         escalations=int(escalations) if defense else None,
         suspicion=(
             None if susp is None else np.round(susp, 6).tolist()
@@ -174,8 +230,309 @@ def run_cell(args, task, name, *, attack=None, attack_params=None,
         wall_s=round(time.time() - t0, 3),
     )
     print(f"[{name}] accuracy {acc:.4f} "
+          f"({rec['wall_s']}s, mag={rec['attack_magnitude']}, "
+          f"confusion={rec['confusion']}, asr={rec['asr']})", flush=True)
+    return rec
+
+
+def _task_n(args, n):
+    """The gradient-plane task re-sharded for ``n`` slots (model/gossip
+    cells use fewer, bigger shards so divergence is real)."""
+    import os
+
+    os.environ.setdefault("GARFIELD_SURROGATE_MARGIN", str(args.margin))
+    module = select_model("pimanet", "pima")
+    loss = selectors.select_loss("bce")
+    opt = selectors.select_optimizer(
+        "sgd", lr=args.lr, momentum=0.0, weight_decay=0.0
+    )
+    m = data_lib.DatasetManager("pima", args.batch, n, n, 0)
+    m.num_ps = 0
+    xs, ys = m.sharded_train_batches()
+    test = parallel.EvalSet(m.get_test_set(), binary=True)
+    return module, loss, opt, xs, ys, test
+
+
+def _run_plane_cell(args, name, build, *, plane, attack, defense,
+                    mag_metric, gar_name, n, f, xs, ys, test):
+    """Shared cell driver for the model/gossip planes: train, track the
+    adaptive magnitude metric, return the schema-v8 record."""
+    t0 = time.time()
+    init_fn, step_fn, eval_fn = build()
+    state = init_fn(jax.random.PRNGKey(args.seed), xs[0, 0])
+    last_mag = None
+    num_batches = xs.shape[1]
+    for i in range(args.num_iter):
+        b = i % num_batches
+        state, metrics = step_fn(
+            state, jnp.asarray(xs[:, b]), jnp.asarray(ys[:, b])
+        )
+        if mag_metric in metrics:
+            last_mag = float(metrics[mag_metric])
+    acc = parallel.compute_accuracy(state, eval_fn, test, binary=True)
+    rec = tele_fmt.make_record(
+        "defense_bench",
+        cell=name,
+        plane=plane,
+        gar=str(gar_name),
+        attack=attack,
+        defense=defense,
+        n=int(n), f=int(f),
+        steps=int(args.num_iter),
+        seed=int(args.seed),
+        final_accuracy=round(float(acc), 6),
+        attack_magnitude=(
+            None if last_mag is None else round(last_mag, 6)
+        ),
+        wall_s=round(time.time() - t0, 3),
+    )
+    print(f"[{name}] accuracy {acc:.4f} "
           f"({rec['wall_s']}s, mag={rec['attack_magnitude']})", flush=True)
     return rec
+
+
+def run_model_cell(args, task, name, *, ps_attack=None,
+                   ps_attack_params=None, defense=False):
+    """One MODEL-plane cell: byzsgd with a Byzantine replica publishing
+    the collusion fake into the fps-tolerant gather. Honest replicas
+    diverge through per-PS gradient subsets (the async reality), which
+    is the spread the model-plane ALIE hides inside. The defended cell
+    runs the in-graph per-plane suspicion weighting (``defense=`` —
+    worker AND replica histories)."""
+    module, loss, opt, xs, ys, test = task
+
+    def build():
+        return byzsgd.make_trainer(
+            module, loss, opt, "krum",
+            num_workers=N_WORKERS, num_ps=N_PS, fw=F, fps=FPS,
+            subset=N_WORKERS - F,
+            ps_attack=ps_attack,
+            ps_attack_params=dict(ps_attack_params or {}),
+            defense=(
+                {"halflife": args.halflife or 16.0} if defense else None
+            ),
+        )
+
+    return _run_plane_cell(
+        args, name, build, plane="model", attack=ps_attack,
+        defense="weighted" if defense else None,
+        mag_metric="ps_attack_mag", gar_name="krum", n=N_PS, f=FPS,
+        xs=xs, ys=ys, test=test,
+    )
+
+
+def run_gossip_cell(args, task, name, *, model_attack=None,
+                    model_attack_params=None, defense=False):
+    """One GOSSIP-plane cell: LEARN nodes under wait-n-f subsets with
+    Byzantine nodes poisoning the plane-2 model gossip; the defended
+    cell weights all three phases by the carried per-node suspicion
+    EMA (``defense=``)."""
+    module, loss, opt, xs, ys, test = task
+
+    def build():
+        return learn.make_trainer(
+            module, loss, opt, "krum",
+            num_nodes=N_NODES, f=F_NODES, subset=NODE_SUBSET,
+            model_attack=model_attack,
+            model_attack_params=dict(model_attack_params or {}),
+            defense=(
+                {"halflife": args.halflife or 16.0} if defense else None
+            ),
+        )
+
+    return _run_plane_cell(
+        args, name, build, plane="gossip", attack=model_attack,
+        defense="weighted" if defense else None,
+        mag_metric="model_attack_mag", gar_name="krum",
+        n=N_NODES, f=F_NODES, xs=xs, ys=ys, test=test,
+    )
+
+
+def run_grid(args):
+    """The r02 PLANE x ATTACK x DEFENSE grid (DESIGN.md §17)."""
+    task = _task(args)
+    adaptive_params = {"mag_max": args.mag_max}
+    plane_params = {"mag_max": PLANE_MAG_MAX}
+    cells = [
+        # --- gradient plane (aggregathor) ------------------------------
+        run_cell(args, task, "grad/clean"),
+        run_cell(args, task, "grad/static-lie", attack="lie",
+                 attack_params={"z": LIE_Z}),
+        run_cell(args, task, "grad/adaptive-lie/off",
+                 attack="adaptive-lie", attack_params=adaptive_params),
+        run_cell(args, task, "grad/adaptive-lie/escalate",
+                 attack="adaptive-lie", attack_params=adaptive_params,
+                 defense=True),
+        run_cell(args, task, "grad/static-empire", attack="empire",
+                 attack_params={"eps": 10.0}),
+        run_cell(args, task, "grad/adaptive-empire/off",
+                 attack="adaptive-empire",
+                 attack_params={"mag_max": args.mag_max}),
+        run_cell(args, task, "grad/adaptive-empire/escalate",
+                 attack="adaptive-empire",
+                 attack_params={"mag_max": args.mag_max}, defense=True),
+        # --- targeted family (gradient plane data poisoning) -----------
+        run_cell(args, task, "grad/labelflip/off", attack="labelflip",
+                 attack_params=dict(args.targeted_params)),
+        run_cell(args, task, "grad/labelflip/escalate",
+                 attack="labelflip",
+                 attack_params=dict(args.targeted_params), defense=True),
+        run_cell(args, task, "grad/backdoor/off", attack="backdoor",
+                 attack_params=dict(args.targeted_params)),
+        run_cell(args, task, "grad/backdoor/escalate", attack="backdoor",
+                 attack_params=dict(args.targeted_params), defense=True),
+    ]
+    # --- model plane (byzsgd, Byzantine replica) -----------------------
+    task_m = task
+    cells += [
+        run_model_cell(args, task_m, "model/clean"),
+        run_model_cell(args, task_m, "model/static-lie",
+                       ps_attack="lie", ps_attack_params={"z": LIE_Z}),
+        run_model_cell(args, task_m, "model/adaptive-lie/off",
+                       ps_attack="adaptive-lie",
+                       ps_attack_params=plane_params),
+        run_model_cell(args, task_m, "model/adaptive-lie/weighted",
+                       ps_attack="adaptive-lie",
+                       ps_attack_params=plane_params, defense=True),
+    ]
+    # --- gossip plane (learn, Byzantine nodes) -------------------------
+    task_g = _task_n(args, N_NODES)
+    cells += [
+        run_gossip_cell(args, task_g, "gossip/clean"),
+        run_gossip_cell(args, task_g, "gossip/static-lie",
+                        model_attack="lie",
+                        model_attack_params={"z": LIE_Z}),
+        run_gossip_cell(args, task_g, "gossip/adaptive-lie/off",
+                        model_attack="adaptive-lie",
+                        model_attack_params=plane_params),
+        run_gossip_cell(args, task_g, "gossip/adaptive-lie/weighted",
+                        model_attack="adaptive-lie",
+                        model_attack_params=plane_params,
+                        defense=True),
+    ]
+    by = {c["cell"]: c for c in cells}
+    acc = {k: c["final_accuracy"] for k, c in by.items()}
+
+    def mag(cell):
+        return by[cell]["attack_magnitude"]
+
+    clean_conf = by["grad/clean"]["confusion"] or 0.0
+    clean_asr = by["grad/clean"]["asr"] or 0.0
+    verdicts = {
+        # Per plane: with defense OFF the adaptive attacker does at least
+        # as much accuracy damage as its static counterpart (strictly
+        # more, by degrade_margin, on the gradient plane — the planes
+        # where the rule already pins every magnitude can only tie).
+        "grad_adaptive_beats_static": bool(
+            acc["grad/adaptive-lie/off"]
+            <= acc["grad/static-lie"] - args.degrade_margin
+        ),
+        # Empire's reference eps=10 is EXCLUDED outright by krum, so the
+        # static cell measures trajectory noise, not attack success —
+        # the adaptive row gates on damage vs CLEAN instead (its static
+        # counterpart's accuracy is recorded in the cells).
+        "grad_adaptive_empire_damages": bool(
+            acc["grad/adaptive-empire/off"]
+            <= acc["grad/clean"] - args.degrade_margin
+        ),
+        "model_adaptive_beats_static": bool(
+            acc["model/adaptive-lie/off"] <= acc["model/static-lie"]
+        ),
+        "gossip_adaptive_beats_static": bool(
+            acc["gossip/adaptive-lie/off"] <= acc["gossip/static-lie"]
+        ),
+        # ...and the defense restores the matrix accuracy bar
+        # (acc >= clean - acc_margin) on every plane.
+        "grad_defense_restores_bar": bool(
+            acc["grad/adaptive-lie/escalate"]
+            >= acc["grad/clean"] - args.acc_margin
+        ),
+        "grad_defense_restores_bar_empire": bool(
+            acc["grad/adaptive-empire/escalate"]
+            >= acc["grad/clean"] - args.acc_margin
+        ),
+        "model_defense_restores_bar": bool(
+            acc["model/adaptive-lie/weighted"]
+            >= acc["model/clean"] - args.acc_margin
+        ),
+        "gossip_defense_restores_bar": bool(
+            acc["gossip/adaptive-lie/weighted"]
+            >= acc["gossip/clean"] - args.acc_margin
+        ),
+        # Bracket pinning: where the defended rule refuses the fake, the
+        # bisection collapses onto mag_min (the model plane's gather does
+        # this exactly); the gradient/gossip defended cells must at
+        # minimum deny the attacker its undefended ACCURACY damage —
+        # recorded per cell as attack_magnitude for the full picture.
+        "model_attacker_pinned_to_floor": bool(
+            mag("model/adaptive-lie/weighted") is not None
+            and mag("model/adaptive-lie/weighted") <= 0.5
+        ),
+        "grad_defense_beats_undefended": bool(
+            acc["grad/adaptive-lie/escalate"]
+            >= acc["grad/adaptive-lie/off"]
+        ),
+        "gossip_defense_beats_undefended": bool(
+            acc["gossip/adaptive-lie/weighted"]
+            >= acc["gossip/adaptive-lie/off"]
+        ),
+        # Targeted family: the attack is measurable with defense off and
+        # its success rate drops below 2x the clean-confusion baseline
+        # under the defended row.
+        "labelflip_measurable": bool(
+            by["grad/labelflip/off"]["confusion"] > clean_conf
+        ),
+        "labelflip_defended": bool(
+            by["grad/labelflip/escalate"]["confusion"]
+            < 2.0 * max(clean_conf, 1e-3)
+        ),
+        "backdoor_measurable": bool(
+            by["grad/backdoor/off"]["asr"] > clean_asr
+        ),
+        # Finding, recorded not gated: the backdoor's trigger ASR
+        # SURVIVES the divergence-based defense (its gradients are
+        # honest gradients of the poisoned task — consistent with the
+        # backdoor literature). The per-class telemetry is what makes
+        # this gap measurable at all; closing it needs a data-plane
+        # defense, not a GAR (DESIGN.md §17).
+        "backdoor_asr_off": by["grad/backdoor/off"]["asr"],
+        "backdoor_asr_defended": by["grad/backdoor/escalate"]["asr"],
+        "clean_confusion": clean_conf,
+        "clean_asr": clean_asr,
+    }
+    doc = {
+        "bench": "defense_bench",
+        "grid": "r02",
+        "schema_v": tele_fmt.SCHEMA_VERSION,
+        "config": {
+            "grad": {"n": N_WORKERS, "f": F},
+            "model": {"n_w": N_WORKERS, "n_ps": N_PS, "fps": FPS,
+                      "subset": N_WORKERS - F},
+            "gossip": {"n": N_NODES, "f": F_NODES,
+                       "subset": NODE_SUBSET},
+            "num_iter": args.num_iter, "batch": args.batch,
+            "lr": args.lr, "seed": args.seed, "margin": args.margin,
+            "mag_max": args.mag_max, "halflife": args.halflife,
+            "theta_up": args.theta_up, "theta_down": args.theta_down,
+            "patience": args.patience, "acc_margin": args.acc_margin,
+            "degrade_margin": args.degrade_margin,
+            "targeted_params": dict(args.targeted_params),
+        },
+        "accuracy": acc,
+        "verdicts": verdicts,
+        "cells": cells,
+    }
+    with open(args.out + ".json", "w") as fp:
+        json.dump(doc, fp, indent=1)
+    with open(args.out + ".jsonl", "w") as fp:
+        for c in cells:
+            tele_fmt.validate_record(c)
+            fp.write(json.dumps(c) + "\n")
+    print(json.dumps({"accuracy": acc, "verdicts": verdicts}, indent=1))
+    gates = [v for k, v in verdicts.items() if isinstance(v, bool)]
+    ok = all(gates)
+    print(f"defense_bench grid: {'ACCEPTED' if ok else 'REJECTED'}")
+    return 0 if ok else 1
 
 
 def main(argv=None):
@@ -201,7 +558,20 @@ def main(argv=None):
                    help="Defense cell must land within this of clean.")
     p.add_argument("--degrade_margin", type=float, default=0.01,
                    help="Adaptive must undercut static by at least this.")
+    p.add_argument("--grid", action="store_true",
+                   help="Run the r02 PLANE x ATTACK x DEFENSE grid "
+                        "(gradient/model/gossip x adaptive/targeted x "
+                        "off/weighted/escalate) instead of the r01 "
+                        "gradient-plane cells.")
+    p.add_argument("--targeted_params", type=json.loads,
+                   default={"source": 0, "target": 1},
+                   help="Targeted-attack knobs for the grid's labelflip/"
+                        "backdoor cells (source/target/poison_frac/"
+                        "trigger_*).")
     args = p.parse_args(argv)
+
+    if args.grid:
+        return run_grid(args)
 
     task = _task(args)
     adaptive_params = {"mag_max": args.mag_max}

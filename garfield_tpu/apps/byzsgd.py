@@ -22,11 +22,19 @@ def main(argv=None):
     parser.add_argument(
         "--ps_attack", type=str, default=None,
         help="Byzantine server model attack: random, reverse, drop "
-             "(byzServer.py:74-78).",
+             "(byzServer.py:74-78); lie, empire (model-plane collusion "
+             "over the gathered replica stack, DESIGN.md §17); "
+             "adaptive-lie, adaptive-empire (the collusion magnitude "
+             "bisected against the model gather's admission feedback — "
+             "in-graph the bracket rides TrainState.attack_state, in "
+             "--cluster mode a real Byzantine PS probes the replica "
+             "plane's forward delta).",
     )
     parser.add_argument(
         "--ps_attack_params", type=__import__("json").loads, default={},
-        help="Model-attack parameters as JSON.",
+        help="Model-attack parameters as JSON (z/eps for the collusion "
+             'attacks; adaptive knobs: {"mag_max": 12.0, "f_pool": 2, '
+             '"rotation": 8}).',
     )
     parser.add_argument(
         "--model_gar", type=str, default=None,

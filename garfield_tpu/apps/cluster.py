@@ -126,6 +126,15 @@ def _host_attack(name, params, fw):
                 f"honest gradients to simulate (got {cohort})"
             )
         return "adaptive", None, cohort
+    if name in ("labelflip", "backdoor"):
+        # Targeted data poisoner (attacks/targeted.py, DESIGN.md §17):
+        # the worker rewrites its OWN batches (label flips / trigger
+        # stamps) and publishes the honest gradient of the poisoned task
+        # — nothing divergence-shaped for the suspicion plane to see.
+        # The role builds the TargetedConfig itself AFTER its telemetry
+        # hub is installed, so the one-time binary-surrogate fallback
+        # event reaches the stream.
+        return "targeted", None, None
     scale = float(params.get("scale", 100.0))
     rng = np.random.default_rng(int(params.get("seed", 666)))
     if name == "random":
@@ -155,8 +164,26 @@ def _host_attack(name, params, fw):
         return "cohort", fn, cohort
     raise SystemExit(
         f"unknown cluster attack {name!r}; workers support random/reverse/"
-        "lie/empire (or kill the process for a crash)."
+        "lie/empire, the adaptive controllers (adaptive-lie/"
+        "adaptive-empire), the targeted poisoners (labelflip/backdoor) — "
+        "or kill the process for a crash."
     )
+
+
+def _targeted_config(args, who):
+    """``TargetedConfig`` for a cluster role running a targeted attack —
+    built AFTER the role's telemetry hub is installed (the one-time
+    binary-surrogate fallback event must reach the stream)."""
+    from .. import models as models_lib
+    from ..attacks import targeted as targeted_lib
+
+    try:
+        return targeted_lib.configure(
+            args.attack, args.attack_params,
+            num_classes=models_lib.num_classes_dict.get(args.dataset, 2),
+        )
+    except ValueError as e:
+        raise SystemExit(f"[{who}] --attack {args.attack}: {e}") from e
 
 
 def _host_model_attack(name, params):
@@ -179,8 +206,171 @@ def _host_model_attack(name, params):
         ).astype(m.dtype)
     raise SystemExit(
         f"unknown PS model attack {name!r}; supported: random, reverse, "
-        "drop (byzServer.py:74-78)."
+        "drop (byzServer.py:74-78), the collusion statistics lie/empire "
+        "and their adaptive controllers adaptive-lie/adaptive-empire "
+        "(DESIGN.md §17)."
     )
+
+
+class _ModelPoisoner:
+    """Host-side Byzantine MODEL publisher: one object per attacking role
+    (an MSMW replica under ``--ps_attack``, a LEARN node under
+    ``--model_attack``) covering three attack shapes (DESIGN.md §17):
+
+      - **simple**: byzServer's self-contained random/reverse/drop —
+        the pre-§17 behavior, byte-identical (the whole published frame,
+        stats segment included, goes through the same transform).
+      - **collusion** (``lie``/``empire`` at a fixed z/eps): the
+        publisher hides inside the spread of the model-plane rows it
+        GATHERED last round — unlike the gradient plane it simulates
+        nothing, the protocol hands it every row it wants statistics
+        over (``attacks.adaptive.model_fake``). Until the first gather
+        it publishes honestly (no cohort to collude against yet).
+      - **adaptive** (``adaptive-lie``/``adaptive-empire``): the
+        collusion magnitude is a ``HostController`` bisection bracket.
+        Feedback is the MODEL-plane delta probe: if the poisoned model
+        entered the peers' aggregation at round r, the mean of the
+        honest peers' models moves toward the fake excess between the
+        round-r and round-(r+1) gathers (``model_delta_probe``; the
+        honest-drift estimate is the PREVIOUS round's observed peer
+        delta). Rotation and gap-triggered bursts ride the same
+        controller as the gradient-plane worker.
+
+    The caller feeds every model-plane gather through ``note_gather``
+    (rows + their ranks) and routes every model publication through
+    ``publish_frame``.
+    """
+
+    def __init__(self, name, params, *, n_ranks, f, my_rank, who,
+                 plane="model"):
+        from ..attacks import adaptive as adaptive_lib, LIE_Z, EMPIRE_EPS
+
+        params = dict(params or {})
+        self.kind = None
+        self.who = who
+        self.plane = plane
+        self.my_rank = int(my_rank)
+        self.base = None
+        self.controller = None
+        self._fn = None
+        self._mag = None
+        self._last_stack = None
+        self._prev_peer_mean = None
+        self._prev_delta = None
+        self._pending = None  # (round, excess u, magnitude)
+        if name is None:
+            return
+        if adaptive_lib.is_adaptive(name):
+            if f < 1:
+                raise SystemExit(
+                    f"--ps_attack/--model_attack {name!r} needs a declared "
+                    f"Byzantine budget >= 1 on its plane (got {f})"
+                )
+            cfg = adaptive_lib.configure(
+                name, params, num_workers=n_ranks, f=f
+            )
+            self.controller = adaptive_lib.HostController(
+                cfg, my_rank,
+                burst_factor=float(params.get("burst_factor", 3.0)),
+                burst_rounds=int(params.get("burst_rounds", 3)),
+            )
+            self.base = cfg.base
+            self.kind = "adaptive"
+        elif name in ("lie", "empire"):
+            self.base = name
+            self._mag = float(params.get(
+                "z" if name == "lie" else "eps",
+                LIE_Z if name == "lie" else EMPIRE_EPS,
+            ))
+            self.kind = "collusion"
+        else:
+            self._fn = _host_model_attack(name, params)
+            self.kind = "simple"
+
+    def note_gather(self, stack, ranks, rnd):
+        """One gathered model-plane stack (params rows, host numpy) with
+        its per-row rank ids: refresh the collusion statistics, feed the
+        burst trigger, and close the pending adaptive probe."""
+        if self.kind in (None, "simple"):
+            return
+        from ..attacks import adaptive as adaptive_lib
+
+        stack = np.asarray(stack, np.float32)
+        ranks = list(ranks)
+        self._last_stack = stack
+        if self.kind != "adaptive":
+            return
+        self.controller.observe_round(time.time())
+        peer_rows = [
+            stack[j] for j, r in enumerate(ranks) if r != self.my_rank
+        ]
+        if not peer_rows:
+            return
+        peer_mean = np.mean(np.stack(peer_rows), axis=0)
+        if self._pending is not None and self._prev_peer_mean is not None:
+            pr, u, mag = self._pending
+            detected, score = adaptive_lib.model_delta_probe(
+                self._prev_peer_mean, peer_mean, u,
+                honest_delta=self._prev_delta,
+            )
+            self.controller.feedback(detected)
+            tele_hooks.emit_event(
+                "ps_attack_adapt", step=int(pr), plane=self.plane,
+                magnitude=round(float(mag), 6), detected=bool(detected),
+                lo=round(self.controller.lo, 6),
+                hi=round(self.controller.hi, 6),
+                score=round(float(score), 6),
+            )
+            self._pending = None
+        if self._prev_peer_mean is not None:
+            # The NEXT probe's honest-drift estimate: what the peers'
+            # mean moved this round (smooth across rounds; the previous
+            # poison's contribution is second-order at probe scale).
+            self._prev_delta = peer_mean - self._prev_peer_mean
+        self._prev_peer_mean = peer_mean
+
+    def publish_frame(self, params_vec, bn_vec, rnd):
+        """The full ``[params || stats]`` frame this role publishes at
+        round ``rnd``, poisoned per the attack shape. The collusion
+        shapes poison the PARAMS segment (their statistics are over the
+        gathered params rows) and keep the honest stats segment; the
+        simple shapes transform the whole frame (pre-§17 byte parity)."""
+        params_vec = np.asarray(params_vec, np.float32)
+        has_bn = bn_vec is not None and np.asarray(bn_vec).size
+        full = (
+            np.concatenate([params_vec, np.asarray(bn_vec, np.float32)])
+            if has_bn else params_vec
+        )
+        if self.kind is None:
+            return full
+        if self.kind == "simple":
+            return self._fn(full).astype(np.float32)
+        if self._last_stack is None:
+            return full  # no gathered cohort to collude against yet
+        from ..attacks import adaptive as adaptive_lib
+
+        if self.kind == "collusion":
+            fake = adaptive_lib.model_fake(
+                self.base, self._last_stack, self._mag
+            )
+        else:
+            if not self.controller.is_active(rnd):
+                return full  # rotation: this round the role plays honest
+            mag = self.controller.magnitude()
+            fake = adaptive_lib.model_fake(self.base, self._last_stack, mag)
+            if not self.controller.bursting():
+                self._pending = (
+                    int(rnd), fake - self._last_stack.mean(axis=0), mag
+                )
+        return (
+            np.concatenate([fake, np.asarray(bn_vec, np.float32)])
+            if has_bn else fake
+        )
+
+    def stats(self):
+        if self.controller is None:
+            return None
+        return self.controller.stats()
 
 
 def _startup_ms(args):
@@ -878,8 +1068,10 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
                     f"escalation-ladder rule ({allowed}), got {args.gar!r}"
                 )
             esc_policy = defense_plan.policy()
-            if args.gar in esc_policy.config.levels:
-                esc_policy.level = esc_policy.config.levels.index(args.gar)
+            esc_policy.level = defense_lib.start_level(
+                esc_policy.config.levels, args.gar,
+                getattr(args, "gar_params", None),
+            )
             lvl_gar, lvl_params = esc_policy.current()
             gar = gars[lvl_gar]
             gar_params = {**base_gar_params, **lvl_params}
@@ -1562,17 +1754,46 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
     f = args.fw
     fps = getattr(args, "fps", 0)
     gar = gars[args.gar]
-    if getattr(args, "defense", None):
-        tools.warning(
-            "--defense is deployed on the SSMW PS and the on-mesh "
-            "topologies; MSMW replicas run the configured rule unchanged"
-        )
+    gar_params = dict(getattr(args, "gar_params", None) or {})
+    base_gar_params = dict(gar_params)
+    # Closed-loop defense on the MSMW GRADIENT plane (DESIGN.md §17):
+    # the SSMW PS's deployment verbatim — suspicion weighting from this
+    # replica's own MetricsHub plus the per-replica escalation ladder.
+    # The model plane's rule stays PINNED at the configured model GAR
+    # (per-plane ladder independence: the fps gather's contract is not
+    # this ladder's to change).
+    defense_plan = defense_lib.resolve(args)
+    esc_policy = None
+    if defense_plan is not None:
+        if not getattr(args, "telemetry", None):
+            args.telemetry = "telemetry"
+        if defense_plan.escalate:
+            allowed = sorted(
+                k for k in defense_lib.LEVEL_RULES if k in gars
+            )
+            if args.gar not in allowed:
+                raise SystemExit(
+                    f"--defense escalate needs --gar to name a REGISTERED "
+                    f"escalation-ladder rule ({allowed}), got {args.gar!r}"
+                )
+            esc_policy = defense_plan.policy()
+            esc_policy.level = defense_lib.start_level(
+                esc_policy.config.levels, args.gar,
+                getattr(args, "gar_params", None),
+            )
+            lvl_gar, lvl_params = esc_policy.current()
+            gar = gars[lvl_gar]
+            gar_params = {**base_gar_params, **lvl_params}
     model_gar_name = getattr(args, "model_gar", None) or args.gar
-    model_attack = _host_model_attack(
+    # Byzantine replica (--ps_attack): byzServer's simple attacks, the
+    # model-plane collusion statistics, or the ADAPTIVE controller
+    # bisecting against the replica gather (DESIGN.md §17).
+    poisoner = _ModelPoisoner(
         getattr(args, "ps_attack", None),
         dict(getattr(args, "ps_attack_params", None) or {}),
+        n_ranks=len(ps_ranks), f=fps, my_rank=pindex,
+        who=f"cluster-ps-{pindex}", plane="model",
     )
-    gar_params = dict(getattr(args, "gar_params", None) or {})
     opt_state = optimizer.init(params0)
     bn0_flat, bn_unravel = ravel_pytree(ms0)
     bn_elems = int(np.asarray(bn0_flat).size)
@@ -1593,41 +1814,50 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
         meta={"attack": getattr(args, "attack", None), "q": q,
               "fps": int(fps), "model_gar": model_gar_name},
     )
-    tap_fn = None
-    if tele_hub is not None:
+    def _build_tap(g, gp):
+        if tele_hub is None:
+            return None
         from ..telemetry import taps as taps_lib
 
         @jax.jit
         def tap_fn(stack, sel):
-            bundle = taps_lib.compute_flat(
-                gar.name, stack, f, params=gar_params
-            )
+            bundle = taps_lib.compute_flat(g.name, stack, f, params=gp)
             return taps_lib.scatter(bundle, sel, n_w)
 
-    def _update_body(flat_params, opt_state, grads_stack, step):
-        if f or args.gar != "average":
-            agg = gar.unchecked(
-                grads_stack, f=f,
-                key=jax.random.fold_in(gar_base_key, step), **gar_params,
-            )
-        else:
-            agg = jnp.mean(grads_stack, axis=0)
-        params = unravel(flat_params)
-        updates, opt_state = optimizer.update(
-            unravel(agg), opt_state, params
-        )
-        params = optax.apply_updates(params, updates)
-        return ravel_pytree(params)[0], opt_state
+        return tap_fn
 
-    ps_update = jax.jit(_update_body)
-    # Staleness-weighted twin (DESIGN.md §14) — see _run_ps: weights
-    # compose into the stack before the GAR; all-fresh quorums dispatch
-    # the unweighted program (the --max_staleness 0 bitwise contract).
-    ps_update_weighted = jax.jit(
-        lambda fp, ost, stack, w, step: _update_body(
-            fp, ost, stack * w[:, None], step
+    tap_fn = _build_tap(gar, gar_params)
+
+    def _build_updates(g, gp):
+        """(ps_update, ps_update_weighted) jits for one rule — rebuilt on
+        a defense-escalation level change (the SSMW PS convention)."""
+
+        def _update_body(flat_params, opt_state, grads_stack, step):
+            if f or g.name != "average":
+                agg = g.unchecked(
+                    grads_stack, f=f,
+                    key=jax.random.fold_in(gar_base_key, step), **gp,
+                )
+            else:
+                agg = jnp.mean(grads_stack, axis=0)
+            params = unravel(flat_params)
+            updates, opt_state2 = optimizer.update(
+                unravel(agg), opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
+            return ravel_pytree(params)[0], opt_state2
+
+        # Staleness/suspicion-weighted twin (DESIGN.md §14/§16) — see
+        # _run_ps: weights compose into the stack before the GAR;
+        # all-fresh fully-trusted quorums dispatch the unweighted program
+        # (the --max_staleness 0 bitwise contract).
+        return jax.jit(_update_body), jax.jit(
+            lambda fp, ost, stack, w, step: _update_body(
+                fp, ost, stack * w[:, None], step
+            )
         )
-    )
+
+    ps_update, ps_update_weighted = _build_updates(gar, gar_params)
 
     t0 = time.time()
     flat = np.asarray(flat0, np.float32)
@@ -1675,9 +1905,10 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
     i = start_iter
     model_wait = grad_wait = None
     while i < args.num_iter:
-        vec = np.concatenate([flat, bn]) if bn_elems else flat
-        if model_attack is not None:
-            vec = model_attack(vec).astype(np.float32)
+        # Byzantine replica publication (byzServer semantics; the
+        # collusion/adaptive shapes poison the params segment against
+        # the LAST gathered replica stack — _ModelPoisoner).
+        vec = poisoner.publish_frame(flat, bn if bn_elems else None, i)
         # Fan out to the FULL original plane (a dead rank costs one
         # bounded sender queue; excluding a merely-slow rank would starve
         # it into a real partition — _ModelPlane docstring). NOTE: after a
@@ -1715,6 +1946,13 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
             model_wait = grad_wait = None
             continue
         model_wait = None  # consumed
+        if poisoner.kind is not None:
+            # Collusion statistics + adaptive probe feed (the gathered
+            # rows are this round's replica plane, ranks in sorted
+            # order — _collect_models' stacking contract).
+            poisoner.note_gather(
+                np.asarray(models_p), sorted(plane.ranks), i
+            )
         flat_dev = plane.aggregate(models_p)
         if bn_elems:
             # Model-plane BN aggregate (fps budget) — BLENDED with the
@@ -1776,6 +2014,28 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
                     bn = 0.5 * (bn_plane + _robust_stats(
                         np.stack([rows[k][1] for k in quorum]), f
                     ))
+            if defense_plan is not None and tele_hub is not None:
+                # Suspicion weighting on the MSMW gradient plane
+                # (DESIGN.md §17): the SSMW PS's per-quorum composition
+                # verbatim — decayed median-relative suspicion from this
+                # replica's own hub, multiplied into the same row-scale
+                # slot as the staleness discount.
+                susp = tele_hub.suspicion_decayed()
+                if susp is not None:
+                    qidx = [k - worker_ranks[0] for k in quorum]
+                    w_def = np.asarray(defense_lib.suspicion_weights(
+                        susp, power=defense_plan.power,
+                        floor=defense_plan.floor,
+                    ))[qidx].astype(np.float32)
+                    tele_hooks.emit_event(
+                        "defense_weights", who=who, step=int(i),
+                        ranks=[int(x) for x in qidx],
+                        weights=[round(float(x), 6) for x in w_def],
+                    )
+                    if not np.all(w_def == 1.0):
+                        w = w_def if w is None else (
+                            np.asarray(w) * w_def
+                        ).astype(np.float32)
             if w is not None and not np.all(w == 1.0):
                 stack_gar = stack * jnp.asarray(w)[:, None]
                 flat_dev, opt_state = ps_update_weighted(
@@ -1798,6 +2058,53 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
                 tele_hub.record_step(
                     i, tap=tap_fn(stack_gar, sel),
                 )
+        if esc_policy is not None and tele_hub is not None:
+            # Per-replica escalation ladder on the gradient plane
+            # (DESIGN.md §17) — the SSMW PS's hysteresis loop: a level
+            # infeasible at this quorum size is refused loudly and
+            # reverted; the model plane's rule never moves.
+            susp = tele_hub.suspicion_decayed()
+            if susp is not None:
+                conc = float(defense_lib.suspicion_concentration(
+                    susp, max(1, f)
+                ))
+                act = esc_policy.observe(conc)
+                if act:
+                    name, lvl_params = esc_policy.current()
+                    new_gar = gars[name]
+                    msg = new_gar.check(
+                        np.zeros((q, 4), np.float32), f=f
+                    ) if f else None
+                    if msg is not None:
+                        tools.warning(
+                            f"[{who}] defense cannot escalate to "
+                            f"{name!r} at q={q}: {msg}"
+                        )
+                        esc_policy.level -= act
+                    else:
+                        gar = new_gar
+                        gar_params = {**base_gar_params, **lvl_params}
+                        ps_update, ps_update_weighted = _build_updates(
+                            gar, gar_params
+                        )
+                        tap_fn = _build_tap(gar, gar_params)
+                        tools.warning(
+                            f"[{who}] defense "
+                            f"{'escalates' if act > 0 else 'de-escalates'}"
+                            f" to {esc_policy.level_name!r} at step {i} "
+                            f"(suspicion concentration {conc:.3f})"
+                        )
+                        tele_hooks.emit_event(
+                            "defense_escalate", who=who, step=int(i),
+                            plane="gradient",
+                            level=int(esc_policy.level),
+                            rule=str(esc_policy.level_name),
+                            direction=(
+                                "escalate" if act > 0 else "deescalate"
+                            ),
+                            gar=name,
+                            concentration=round(conc, 6),
+                        )
         losses_seen = i + 1
         if ckpt and args.checkpoint_freq and (i + 1) % args.checkpoint_freq == 0:
             with tele_trace.span("checkpoint", step=i):
@@ -1844,6 +2151,8 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
         "final_accuracy": acc,
         "steps": losses_seen,
         "wall_s": time.time() - t0,
+        **({"ps_attack_adapt": poisoner.stats()}
+           if poisoner.stats() else {}),
     }
     _telemetry_close(tele_hub, tele_exp)
     print(json.dumps({"tag": who, **summary}), flush=True)
@@ -1960,17 +2269,56 @@ def _run_learn(args):
         # of silently running an oblivious loop.
         raise SystemExit(
             f"--attack {args.attack!r} drives the PS-topology worker "
-            "role; LEARN nodes support the oblivious attacks "
-            "(random/reverse/lie/empire)"
+            "role; LEARN nodes support the oblivious gradient attacks "
+            "(random/reverse/lie/empire), the targeted poisoners "
+            "(labelflip/backdoor), and the ADAPTIVE gossip attacks via "
+            "--model_attack adaptive-* (the model plane is where a "
+            "LEARN node has a probe)"
         )
-    if getattr(args, "defense", None):
-        tools.warning(
-            "--defense is deployed on the SSMW PS and the on-mesh "
-            "topologies; LEARN nodes run the configured rule unchanged"
+    # Closed-loop defense on LEARN's gossip phases (DESIGN.md §17): one
+    # ``PlaneDefense`` PER PLANE — the gradient gather and the model
+    # gossip keep INDEPENDENT decayed exclusion histories and independent
+    # escalation ladders (the gradient ladder moving must not drag the
+    # gossip rule along, and vice versa). Suspicion weights compose into
+    # ``node_update_weighted``/``model_aggregate_weighted`` through the
+    # same row-scale slot as the async staleness discount; the per-level
+    # jits are cached per rule like the SSMW PS's.
+    defense_plan = defense_lib.resolve(args)
+    grad_def = gossip_def = None
+    if defense_plan is not None:
+        if not getattr(args, "telemetry", None):
+            args.telemetry = "telemetry"
+        if defense_plan.escalate:
+            allowed = sorted(
+                k for k in defense_lib.LEVEL_RULES if k in gars
+            )
+            for plane_name, rule in (
+                ("gradient", args.gar),
+                ("gossip", getattr(args, "model_gar", None) or args.gar),
+            ):
+                if rule not in allowed:
+                    raise SystemExit(
+                        f"--defense escalate on the LEARN {plane_name} "
+                        f"plane needs its rule to name a REGISTERED "
+                        f"escalation-ladder level ({allowed}), got "
+                        f"{rule!r}"
+                    )
+        grad_def = defense_lib.PlaneDefense(
+            defense_plan, n, f=f, plane="gradient",
+            base_gar=args.gar, base_params=gar_params,
         )
-    model_attack = _host_model_attack(
+        gossip_def = defense_lib.PlaneDefense(
+            defense_plan, n, f=f, plane="gossip",
+            base_gar=getattr(args, "model_gar", None) or args.gar,
+        )
+    # Byzantine gossip publisher (--model_attack): byzServer's simple
+    # attacks, the model-plane collusion statistics, or the ADAPTIVE
+    # controller bisecting against the gossip quorum (DESIGN.md §17).
+    poisoner = _ModelPoisoner(
         getattr(args, "model_attack", None),
         dict(getattr(args, "model_attack_params", None) or {}),
+        n_ranks=n, f=f, my_rank=me, who=f"cluster-node-{me}",
+        plane="gossip",
     )
     beta = getattr(args, "worker_momentum", None)
     mom = None
@@ -1983,47 +2331,163 @@ def _run_learn(args):
         grads, (loss, new_ms) = grad_fn(unravel(flat_params), ms, x, y, rng)
         return ravel_pytree(grads)[0], loss, new_ms
 
-    def _node_update_body(flat_params, opt_state, grads_stack, step):
-        agg = gar.unchecked(
-            grads_stack, f=f,
-            key=jax.random.fold_in(gar_base_key, step), **gar_params,
-        )
-        params = unravel(flat_params)
-        updates, opt_state = optimizer.update(
-            unravel(agg), opt_state, params
-        )
-        return ravel_pytree(optax.apply_updates(params, updates))[0], opt_state
+    def _build_node_updates(g, gp):
+        """(node_update, node_update_weighted) jits for one gradient-
+        plane rule — rebuilt on a defense-escalation level change."""
 
-    node_update = jax.jit(_node_update_body)
-    # Staleness-weighted twins (DESIGN.md §15) — the PS plane's
-    # composition verbatim: discount weights scale the rows BEFORE the
-    # rule; an all-fresh quorum dispatches the unweighted programs above,
-    # which is the --max_staleness 0 bitwise contract.
-    node_update_weighted = jax.jit(
-        lambda fp, ost, stack, w, step: _node_update_body(
-            fp, ost, stack * w[:, None], step
+        def _node_update_body(flat_params, opt_state, grads_stack, step):
+            agg = g.unchecked(
+                grads_stack, f=f,
+                key=jax.random.fold_in(gar_base_key, step), **gp,
+            )
+            params = unravel(flat_params)
+            updates, opt_state2 = optimizer.update(
+                unravel(agg), opt_state, params
+            )
+            return (
+                ravel_pytree(optax.apply_updates(params, updates))[0],
+                opt_state2,
+            )
+
+        # Staleness/suspicion-weighted twin (DESIGN.md §15/§17) — the PS
+        # plane's composition verbatim: weights scale the rows BEFORE
+        # the rule; an all-fresh fully-trusted quorum dispatches the
+        # unweighted program, which is the --max_staleness 0 (and
+        # defense-off) bitwise contract.
+        return jax.jit(_node_update_body), jax.jit(
+            lambda fp, ost, stack, w, step: _node_update_body(
+                fp, ost, stack * w[:, None], step
+            )
         )
+
+    node_update, node_update_weighted = _build_node_updates(
+        gar, gar_params
     )
 
-    def _model_aggregate_body(models_stack, step):
-        return model_gar.unchecked(
-            models_stack, f=f,
-            key=jax.random.fold_in(
-                jax.random.fold_in(gar_base_key, step), 1
-            ),
+    def _build_model_aggs(g, gp):
+        """(model_aggregate, model_aggregate_weighted) jits for one
+        gossip-plane rule — the gossip ladder's per-level programs."""
+
+        def _model_aggregate_body(models_stack, step):
+            return g.unchecked(
+                models_stack, f=f,
+                key=jax.random.fold_in(
+                    jax.random.fold_in(gar_base_key, step), 1
+                ), **gp,
+            )
+
+        # Gossip-plane staleness/suspicion composition (DESIGN.md
+        # §15/§17): a discounted model row is treated as the outlier it
+        # is; all-fresh trusted quorums dispatch the unweighted program
+        # (the ms=0 bitwise contract).
+        return jax.jit(_model_aggregate_body), jax.jit(
+            lambda stack, w, step: _model_aggregate_body(
+                stack * w[:, None], step
+            )
         )
 
-    model_aggregate = jax.jit(_model_aggregate_body)
-    # Gossip-plane staleness composition (DESIGN.md §15): a stale model's
-    # row is discounted exactly like a stale gradient's — the robust
-    # model rule then treats the down-scaled row as the outlier it is and
-    # the fresh honest majority keeps its influence; all-fresh quorums
-    # dispatch the unweighted program above (the ms=0 bitwise contract).
-    model_aggregate_weighted = jax.jit(
-        lambda stack, w, step: _model_aggregate_body(
-            stack * w[:, None], step
-        )
+    model_aggregate, model_aggregate_weighted = _build_model_aggs(
+        model_gar, {}
     )
+
+    def _rebuild_grad(new_g, gp):
+        nonlocal gar, gar_params, node_update, node_update_weighted
+        nonlocal grad_tap
+        gar = new_g
+        gar_params = gp
+        node_update, node_update_weighted = _build_node_updates(new_g, gp)
+        grad_tap = _plane_tap(new_g, gp)
+
+    def _rebuild_gossip(new_g, gp):
+        nonlocal model_gar, model_aggregate, model_aggregate_weighted
+        nonlocal gossip_tap
+        model_gar = new_g
+        model_aggregate, model_aggregate_weighted = _build_model_aggs(
+            new_g, gp
+        )
+        gossip_tap = _plane_tap(new_g, gp)
+
+    def _compose_w(w, gw):
+        """Compose a quorum's staleness weights (length q, or None) with
+        the defense's per-row weights (length <= q, or None; pad rows
+        are fully trusted) — one row-scale multiply, like the PS."""
+        if gw is None:
+            return w
+        full = np.ones(q, np.float32)
+        full[:len(gw)] = gw
+        if w is None:
+            return jnp.asarray(full)
+        return jnp.asarray(
+            (np.asarray(w, np.float32) * full).astype(np.float32)
+        )
+
+    def _plane_tap(g, gp):
+        """Jitted per-quorum audit for one plane's rule: the rule's
+        selection weights over exactly the quorum stack it consumed —
+        what feeds the plane's ``PlaneDefense`` history."""
+        from ..telemetry import taps as taps_lib
+
+        @jax.jit
+        def tap(stack, key):
+            return taps_lib.compute_flat(
+                g.name, stack, f, key=key, params=gp
+            )["selected"]
+
+        return tap
+
+    grad_tap = gossip_tap = None
+    if defense_plan is not None:
+        grad_tap = _plane_tap(gar, gar_params)
+        gossip_tap = _plane_tap(model_gar, {})
+
+    def _plane_escalate(pdef, i, rebuild):
+        """One round of a plane's escalation ladder: fold concentration,
+        validate feasibility at q, rebuild the plane's jits on a level
+        change (or revert loudly)."""
+        act = pdef.observe()
+        if not act:
+            return
+        name, lvl_params = pdef.current()
+        new_g = gars[name]
+        msg = new_g.check(np.zeros((q, 4), np.float32), f=f) if f else None
+        if msg is not None:
+            tools.warning(
+                f"[{who}] defense cannot escalate the {pdef.plane} plane "
+                f"to {name!r} at q={q}: {msg}"
+            )
+            pdef.revert(act)
+            return
+        rebuild(new_g, lvl_params)
+        tools.warning(
+            f"[{who}] defense {'escalates' if act > 0 else 'de-escalates'}"
+            f" the {pdef.plane} plane to {pdef.policy.level_name!r} at "
+            f"round {i} (concentration {pdef.concentration():.3f})"
+        )
+        tele_hooks.emit_event(
+            "defense_escalate", who=who, step=int(i), plane=pdef.plane,
+            level=int(pdef.policy.level),
+            rule=str(pdef.policy.level_name),
+            direction="escalate" if act > 0 else "deescalate",
+            gar=name,
+            concentration=round(pdef.concentration(), 6),
+        )
+
+    def _audit_plane(pdef, tap, stack, ranks, i, key, plane):
+        """Fold one quorum's selection verdict into the plane's defense
+        history (+ the per-round defense_weights event) and return the
+        composed per-row weights for THIS quorum (None = all-1.0)."""
+        if pdef is None or not ranks:
+            return None
+        sel = np.asarray(tap(stack, key))[:len(ranks)]
+        pdef.fold(ranks, sel)
+        w = pdef.weights_for(ranks)
+        if w is not None:
+            tele_hooks.emit_event(
+                "defense_weights", who=who, step=int(i), plane=plane,
+                ranks=[int(r) for r in ranks],
+                weights=[round(float(x), 6) for x in w],
+            )
+        return w
 
     def harvest(wait_fn, split):
         """Drain a pre-registered quorum, stack the q lowest-rank
@@ -2035,15 +2499,18 @@ def _run_learn(args):
         data while feeding the GAR substitute zeros would hand the
         attacker a second fault for free); zero rows — a crash-like value
         fault inside the f budget — pad only when fewer than q
-        well-formed frames exist. Returns ``(rows, bn_rows)`` stacks
-        (``bn_rows`` None when the plane carries no stats segment)."""
+        well-formed frames exist. Returns ``(rows, bn_rows, ranks)``:
+        the stacks (``bn_rows`` None when the plane carries no stats
+        segment) plus the contributing peers' rank ids in row order
+        (pad rows carry no rank) — the attribution the per-plane
+        defense audit keys on (DESIGN.md §17)."""
         got = wait_fn()
         d0, d1 = split
         well_formed = []
         for k in sorted(got):
             v = got[k]
             if not isinstance(v, Exception):
-                well_formed.append(v)
+                well_formed.append((k, v))
             elif k not in warned_malformed:  # once per peer, not per round
                 warned_malformed.add(k)
                 tools.warning(
@@ -2051,12 +2518,15 @@ def _run_learn(args):
                     f"wire codec ({v}); dropping its malformed frames "
                     "from every quorum (warned once)"
                 )
-        rows = [v[0] for v in well_formed[:q]]
-        bn_rows = [v[1] for v in well_formed[:q]]
+        ranks = [k for k, _ in well_formed[:q]]
+        rows = [v[0] for _, v in well_formed[:q]]
+        bn_rows = [v[1] for _, v in well_formed[:q]]
         while len(rows) < q:
             rows.append(np.zeros(d0, np.float32))
             bn_rows.append(np.zeros(d1, np.float32))
-        return jnp.stack(rows), (np.stack(bn_rows) if d1 else None)
+        return (
+            jnp.stack(rows), (np.stack(bn_rows) if d1 else None), ranks
+        )
 
     who = f"cluster-node-{me}"
     warned_malformed = set()
@@ -2072,9 +2542,11 @@ def _run_learn(args):
         drop-and-flow like ``harvest``); zero rows pad below q. Emits the
         per-round plane-tagged ``staleness`` telemetry event (schema v6)
         whose discount deficits feed this node's suspicion ranking.
-        Returns ``(stack, bn_stack|None, weights|None)`` — weights None
-        when every admitted row is fresh, so the caller dispatches the
-        UNWEIGHTED jit program (the ms=0 bitwise contract)."""
+        Returns ``(stack, bn_stack|None, weights|None, ranks)`` —
+        weights None when every admitted row is fresh, so the caller
+        dispatches the UNWEIGHTED jit program (the ms=0 bitwise
+        contract); ``ranks`` are the quorum's peer ids in row order
+        (pad rows carry no rank), the defense audit's attribution."""
         got = collector.gather(
             i, q, max_staleness=policy.max_staleness,
             timeout_ms=args.cluster_timeout_ms,
@@ -2127,15 +2599,21 @@ def _run_learn(args):
             jnp.stack(rows),
             (np.stack(bn_rows) if d1 else None),
             (jnp.asarray(w) if not np.all(w == 1.0) else None),
+            list(quorum),
         )
 
-    # Events-only telemetry for LEARN peers: the gossip quorums carry no
-    # rank attribution after `harvest` stacks them, so this role streams
-    # exchange wait latencies + liveness events (the audit taps live on
-    # the PS roles and the on-mesh topologies). Async mode additionally
-    # emits per-plane staleness events, whose discount deficits rank a
-    # straggling peer in this node's suspicion exactly like the PS's.
+    # LEARN-peer telemetry: exchange wait latencies + liveness events
+    # stream here; async mode adds per-plane staleness events whose
+    # discount deficits rank a straggling peer in this node's suspicion.
+    # With --defense the per-plane quorum audits (``_audit_plane``) feed
+    # the node's OWN rank-attributed defense histories — the plane
+    # deployment DESIGN.md §17 describes.
     tele_hub, tele_exp = _telemetry_open(args, who, num_ranks=n)
+    # Targeted poisoner (labelflip/backdoor): config built after the hub
+    # install so the one-time binary-surrogate event reaches the stream.
+    targeted_cfg = None
+    if atk_kind == "targeted":
+        targeted_cfg = _targeted_config(args, who)
     t0 = time.time()
     base_key = jax.random.PRNGKey(args.seed + 1 + me)
     flat = np.asarray(flat0, np.float32)
@@ -2303,8 +2781,20 @@ def _run_learn(args):
                     g = attack(rows)
                 else:
                     b = i % num_batches
+                    xb, yb = my_xs[b], my_ys[b]
+                    if targeted_cfg is not None:
+                        # Targeted poisoning (DESIGN.md §17): rewrite the
+                        # node's OWN batch (label flips / trigger stamps)
+                        # and publish the honest gradient of the
+                        # poisoned task — suspicion-invisible.
+                        from ..attacks import targeted as targeted_lib
+
+                        xb, yb = targeted_lib.poison_batch(
+                            targeted_cfg, np.asarray(xb), np.asarray(yb),
+                            seed=me,
+                        )
                     g, _, ms = worker_grad(
-                        flat_dev, ms, my_xs[b], my_ys[b],
+                        flat_dev, ms, xb, yb,
                         jax.random.fold_in(base_key, i),
                     )
                     g = np.asarray(g, np.float32)
@@ -2380,7 +2870,7 @@ def _run_learn(args):
                 )
                 try:
                     with tele_trace.span("quorum", step=i, plane="grad"):
-                        grads, _, w = gather_rows(
+                        grads, _, w, granks = gather_rows(
                             grad_col, i, grad_split, "grad"
                         )
                 except TimeoutError:
@@ -2390,6 +2880,13 @@ def _run_learn(args):
                         "dropout (reference bounded-retry semantics)"
                     )
                     return i
+                # Per-plane defense (DESIGN.md §17): audit the quorum,
+                # compose the suspicion weights with the staleness
+                # discount, escalate the plane's ladder independently.
+                w = _compose_w(w, _audit_plane(
+                    grad_def, grad_tap, grads, granks, i,
+                    jax.random.fold_in(gar_base_key, i), "gradient",
+                ))
                 with tele_trace.span("update", step=i):
                     if w is not None:
                         flat_dev, opt_state = node_update_weighted(
@@ -2402,13 +2899,14 @@ def _run_learn(args):
                             jnp.asarray(i, jnp.int32),
                         )
                     flat = np.asarray(flat_dev, np.float32)
-                pub = flat
-                if bn_elems:
-                    pub = np.concatenate([
-                        flat, np.asarray(ravel_pytree(ms)[0], np.float32)
-                    ])
-                if model_attack is not None:
-                    pub = model_attack(pub).astype(np.float32)
+                if grad_def is not None:
+                    _plane_escalate(grad_def, i, _rebuild_grad)
+                pub = poisoner.publish_frame(
+                    flat,
+                    (np.asarray(ravel_pytree(ms)[0], np.float32)
+                     if bn_elems else None),
+                    i,
+                )
                 with tele_trace.span("gossip", step=i):
                     ex.publish(
                         i,
@@ -2417,7 +2915,7 @@ def _run_learn(args):
                         plane=PLANE_MODEL,
                     )
                     try:
-                        models_p, models_bn, wm = gather_rows(
+                        models_p, models_bn, wm, mranks = gather_rows(
                             model_col, i, gossip_split, "model"
                         )
                     except TimeoutError:
@@ -2428,6 +2926,17 @@ def _run_learn(args):
                         )
                         models_p = None
                     if models_p is not None:
+                        if poisoner.kind is not None:
+                            poisoner.note_gather(
+                                np.asarray(models_p)[:len(mranks)],
+                                mranks, i,
+                            )
+                        wm = _compose_w(wm, _audit_plane(
+                            gossip_def, gossip_tap, models_p, mranks, i,
+                            jax.random.fold_in(
+                                jax.random.fold_in(gar_base_key, i), 1
+                            ), "gossip",
+                        ))
                         if wm is not None:
                             flat_dev = model_aggregate_weighted(
                                 models_p, wm, jnp.asarray(i, jnp.int32),
@@ -2441,6 +2950,8 @@ def _run_learn(args):
                             ms = bn_unravel(jnp.asarray(
                                 _robust_stats(models_bn, f)
                             ))
+                        if gossip_def is not None:
+                            _plane_escalate(gossip_def, i, _rebuild_gossip)
                 wire_stats.flush(i)
                 if (ckpt and args.checkpoint_freq
                         and (i + 1) % args.checkpoint_freq == 0):
@@ -2512,7 +3023,7 @@ def _run_learn(args):
             )
             try:
                 with tele_trace.span("quorum", step=i, plane="grad"):
-                    grads, _ = harvest(grad_wait, grad_split)
+                    grads, _, granks = harvest(grad_wait, grad_split)
             except TimeoutError:
                 # Dropped out of the quorum flow: the reference's pull
                 # loops retry a bounded number of times then exit
@@ -2528,12 +3039,28 @@ def _run_learn(args):
                     "as a dropout (reference bounded-retry semantics)"
                 )
                 break
+            # Per-plane defense (DESIGN.md §17): audit the quorum, weight
+            # its rows by suspicion, escalate the gradient ladder — all
+            # independent of the gossip plane's history below.
+            gw = _audit_plane(
+                grad_def, grad_tap, grads, granks, i,
+                jax.random.fold_in(gar_base_key, i), "gradient",
+            )
+            gw = _compose_w(None, gw)
             with tele_trace.span("update", step=i):
-                flat_dev, opt_state = node_update(
-                    flat_dev, opt_state, grads,
-                    jnp.asarray(i, jnp.int32),
-                )
+                if gw is not None:
+                    flat_dev, opt_state = node_update_weighted(
+                        flat_dev, opt_state, grads, gw,
+                        jnp.asarray(i, jnp.int32),
+                    )
+                else:
+                    flat_dev, opt_state = node_update(
+                        flat_dev, opt_state, grads,
+                        jnp.asarray(i, jnp.int32),
+                    )
                 flat = np.asarray(flat_dev, np.float32)
+            if grad_def is not None:
+                _plane_escalate(grad_def, i, _rebuild_grad)
             # --- model gossip plane (phase 2i+3) -------------------------
             # Gossip frames are [params || stats] (r5, VERDICT r4 #4): the
             # model GAR aggregates the params, the stats segment goes
@@ -2541,13 +3068,12 @@ def _run_learn(args):
             # twin syncs BN state with core.mean_model_state every step
             # (parallel/learn.py), so local-BN drift here would diverge
             # the deployment shapes on BN architectures.
-            pub = flat
-            if bn_elems:
-                pub = np.concatenate([
-                    flat, np.asarray(ravel_pytree(ms)[0], np.float32)
-                ])
-            if model_attack is not None:
-                pub = model_attack(pub).astype(np.float32)
+            pub = poisoner.publish_frame(
+                flat,
+                (np.asarray(ravel_pytree(ms)[0], np.float32)
+                 if bn_elems else None),
+                i,
+            )
             with tele_trace.span("gossip", step=i):
                 ex.publish(
                     2 * i + 3,
@@ -2555,7 +3081,9 @@ def _run_learn(args):
                                   plane=PLANE_MODEL),
                 )
                 try:
-                    models_p, models_bn = harvest(model_wait, gossip_split)
+                    models_p, models_bn, mranks = harvest(
+                        model_wait, gossip_split
+                    )
                 except TimeoutError:
                     tools.warning(
                         f"[{who}] lost the round-{i} model-gossip quorum; "
@@ -2563,14 +3091,31 @@ def _run_learn(args):
                     )
                     models_p = None
                 if models_p is not None:
-                    flat_dev = model_aggregate(
-                        models_p, jnp.asarray(i, jnp.int32),
-                    )
+                    if poisoner.kind is not None:
+                        poisoner.note_gather(
+                            np.asarray(models_p)[:len(mranks)], mranks, i
+                        )
+                    mw = _compose_w(None, _audit_plane(
+                        gossip_def, gossip_tap, models_p, mranks, i,
+                        jax.random.fold_in(
+                            jax.random.fold_in(gar_base_key, i), 1
+                        ), "gossip",
+                    ))
+                    if mw is not None:
+                        flat_dev = model_aggregate_weighted(
+                            models_p, mw, jnp.asarray(i, jnp.int32),
+                        )
+                    else:
+                        flat_dev = model_aggregate(
+                            models_p, jnp.asarray(i, jnp.int32),
+                        )
                     flat = np.asarray(flat_dev, np.float32)
                     if bn_elems:
                         ms = bn_unravel(jnp.asarray(
                             _robust_stats(models_bn, f)
                         ))
+                    if gossip_def is not None:
+                        _plane_escalate(gossip_def, i, _rebuild_gossip)
             wire_stats.flush(i)
             if (ckpt and args.checkpoint_freq
                     and (i + 1) % args.checkpoint_freq == 0):
@@ -2616,6 +3161,8 @@ def _run_learn(args):
             # Async catch-up jumps (a straggler contributes at its own
             # rate but tracks the swarm clock): rounds it never computed.
             **({"skipped": rounds_skipped} if policy is not None else {}),
+            **({"model_attack_adapt": poisoner.stats()}
+               if poisoner.stats() else {}),
             "wall_s": time.time() - t0,
         }
         _telemetry_close(tele_hub, tele_exp)
@@ -2778,6 +3325,12 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
     # reconstruct the cross-process round timeline (a PS-only stream
     # cannot attribute a slow quorum to the worker that caused it).
     tele_hub, tele_exp = _telemetry_open(args, who)
+    # Targeted poisoner (labelflip/backdoor, DESIGN.md §17): config built
+    # after the hub install so the one-time binary-surrogate fallback
+    # event reaches the stream.
+    targeted_cfg = None
+    if atk_kind == "targeted":
+        targeted_cfg = _targeted_config(args, who)
     wire_stats = _WireStats(who)
     split = (flat_np.size, bn_elems)
     # pass_empty: the PS's stop sentinel is an empty frame, not a codec
@@ -2873,8 +3426,20 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
                 if r:
                     key = jax.random.fold_in(key, 1_000_003 + r)
                 b = (step + r) % num_batches
+                xb, yb = my_xs[b], my_ys[b]
+                if targeted_cfg is not None:
+                    # Targeted poisoning (DESIGN.md §17): rewrite this
+                    # worker's OWN batch and publish the honest gradient
+                    # of the poisoned task — nothing divergence-shaped
+                    # for the PS's suspicion plane to see.
+                    from ..attacks import targeted as targeted_lib
+
+                    xb, yb = targeted_lib.poison_batch(
+                        targeted_cfg, np.asarray(xb), np.asarray(yb),
+                        seed=windex,
+                    )
                 g, loss_, ms_new = worker_grad(
-                    flat_params, ms, my_xs[b], my_ys[b], key,
+                    flat_params, ms, xb, yb, key,
                 )
                 loss, ms = loss_, ms_new
                 g = np.asarray(g, np.float32)

@@ -63,11 +63,16 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
            "adaptive-lie, adaptive-empire (magnitude bisected against the "
            "rule's selection feedback, cohort rotation over an f_pool > fw "
            "colluder pool, full-magnitude bursts in quorum-degradation "
-           "windows).")
+           "windows) — or a TARGETED data poisoner (DESIGN.md §17): "
+           "labelflip (the cohort relabels source-class samples as the "
+           "target class), backdoor (pixel-trigger stamp + target label); "
+           "targeted success is measured per class (ASR, schema v8), not "
+           "as divergence.")
     a("--attack_params", type=json.loads, default={},
       help="Attack parameters as JSON (e.g. lie z, empire eps; adaptive "
            'controller knobs: {"f_pool": 4, "rotation": 8, "mag_max": 6.0, '
-           '"burst": 6.0}).')
+           '"burst": 6.0}; targeted knobs: {"source": 0, "target": 1, '
+           '"poison_frac": 1.0, "trigger_size": 2, "trigger_value": 2.5}).')
     a("--defense", type=str, default=None,
       choices=["none", "weighted", "escalate"],
       help="Closed-loop defense (aggregators/defense.py, DESIGN.md §16): "
@@ -453,10 +458,12 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
             )
         esc_policy = defense_plan.policy()
         levels = esc_policy.config.levels
-        if args.gar in levels:
-            # Start the ladder AT the configured rule (e.g. --gar krum
-            # starts at the classic-krum level and escalates from there).
-            esc_policy.level = levels.index(args.gar)
+        # Start the ladder AT the configured rule's SEMANTICS — the
+        # default krum is the multi-krum level; --defense must never
+        # downgrade the rule it defends (defense.start_level).
+        esc_policy.level = defense_lib.start_level(
+            levels, args.gar, getattr(args, "gar_params", None)
+        )
         if not getattr(args, "telemetry", None):
             args.telemetry = "telemetry"  # suspicion needs the hub
     if trace_lib.requested(args) and not getattr(args, "telemetry", None):
@@ -499,6 +506,20 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
         if trace_lib.requested(args):
             trace_lib.enable(who=tag)
 
+    # Targeted attacks (DESIGN.md §17): resolve the config once — the
+    # trainer poisons the cohort's batches with it, and the eval loop
+    # below measures the per-class/ASR success the suspicion plane is
+    # blind to (telemetry schema v8). Resolved AFTER the hub install so
+    # the one-time binary-surrogate fallback event reaches the stream.
+    from ..attacks import targeted as targeted_lib
+
+    targeted_cfg = None
+    if targeted_lib.is_targeted(getattr(args, "attack", None)):
+        targeted_cfg = targeted_lib.configure(
+            args.attack, getattr(args, "attack_params", None),
+            num_classes=models_lib.num_classes_dict.get(args.dataset, 2),
+        )
+
     def build(step):
         kwargs = dict(make_trainer_kwargs)
         gar_name = args.gar
@@ -509,6 +530,13 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
             # crash-schedule re-jit below.
             gar_name, lvl_params = esc_policy.current()
             gar_params.update(lvl_params)
+            if "model_gar" in kwargs and kwargs.get("model_gar") is None:
+                # Per-plane ladder independence (DESIGN.md §17): the
+                # ladder owns the GRADIENT rule only — a model rule that
+                # defaulted to --gar must stay pinned at the configured
+                # rule, not silently ride the gradient plane's
+                # escalations.
+                kwargs["model_gar"] = args.gar
         if defense_plan is not None and "defense" in trainer_params:
             kwargs["defense"] = {
                 "power": defense_plan.power,
@@ -711,6 +739,22 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                         magnitude=float(m_j["attack_mag"]),
                         detected=bool(m_j["attack_detected"] > 0.5),
                     )
+                for mag_key, det_key, plane in (
+                    ("ps_attack_mag", "ps_attack_detected", "model"),
+                    ("model_attack_mag", "model_attack_detected",
+                     "gossip"),
+                ):
+                    if mag_key in m_j:
+                        # Model-plane adaptive controller (schema v8):
+                        # a Byzantine PS vs the replica gather, or a
+                        # LEARN node vs the model gossip.
+                        tele_hub.record_event(
+                            "ps_attack_adapt",
+                            step=int(i + j),
+                            magnitude=float(m_j[mag_key]),
+                            detected=bool(m_j[det_key] > 0.5),
+                            plane=plane,
+                        )
                 if "defense_w" in m_j:
                     # Suspicion weights the step composed (schema v7) —
                     # the hub digests them into summary.defense.
@@ -719,6 +763,17 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                         step=int(i + j),
                         weights=np.round(
                             np.asarray(m_j["defense_w"], np.float64), 6
+                        ).tolist(),
+                    )
+                if "ps_defense_w" in m_j:
+                    # Replica-plane suspicion weights (schema v8): the
+                    # MSMW twin's second, independent defense history.
+                    tele_hub.record_event(
+                        "defense_weights",
+                        step=int(i + j),
+                        plane="model",
+                        weights=np.round(
+                            np.asarray(m_j["ps_defense_w"], np.float64), 6
                         ).tolist(),
                     )
         if esc_policy is not None and tele_hub is not None:
@@ -792,6 +847,38 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                     on_done=_report,
                     after=eval_threads[-1] if eval_threads else None,
                 ))
+            if targeted_cfg is not None and tele_hub is not None:
+                # Per-class eval digest (schema v8): the targeted
+                # attack's success metric, measured at every eval point
+                # — global accuracy alone cannot see a labelflip/
+                # backdoor (DESIGN.md §17). Inline (blocking): this is a
+                # measurement run by construction.
+                rep = parallel.targeted_eval(
+                    state, eval_fn, test_batches,
+                    source=targeted_cfg.source,
+                    target=targeted_cfg.target,
+                    trigger_cfg=(
+                        targeted_cfg
+                        if targeted_cfg.attack == "backdoor" else None
+                    ),
+                )
+                tele_hub.record_event(
+                    "targeted_eval", step=int(last),
+                    source=rep["source"], target=rep["target"],
+                    accuracy=round(rep["accuracy"], 6),
+                    confusion=(
+                        None if rep["confusion"] is None
+                        else round(rep["confusion"], 6)
+                    ),
+                    asr=(
+                        None if rep["asr"] is None
+                        else round(rep["asr"], 6)
+                    ),
+                    per_class={
+                        str(k): round(v, 6)
+                        for k, v in rep["per_class"].items()
+                    },
+                )
         if ckpt and args.checkpoint_freq and end % args.checkpoint_freq == 0:
             with trace_lib.span("checkpoint", step=end - 1):
                 ckpt.save(end, jax.tree.map(np.asarray, state))
@@ -805,6 +892,37 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
             raise t.exc
     steps_done = args.num_iter - start_iter
     acc = parallel.compute_accuracy(state, eval_fn, test_batches, binary=binary)
+    targeted_rep = None
+    if targeted_cfg is not None:
+        # Run-closing targeted digest: confusion/ASR into the printed
+        # summary (and one last v8 event), so a targeted run's success
+        # metric is never only in the JSONL stream.
+        targeted_rep = parallel.targeted_eval(
+            state, eval_fn, test_batches,
+            source=targeted_cfg.source, target=targeted_cfg.target,
+            trigger_cfg=(
+                targeted_cfg if targeted_cfg.attack == "backdoor" else None
+            ),
+        )
+        if tele_hub is not None:
+            tele_hub.record_event(
+                "targeted_eval", step=int(args.num_iter),
+                source=targeted_rep["source"],
+                target=targeted_rep["target"],
+                accuracy=round(targeted_rep["accuracy"], 6),
+                confusion=(
+                    None if targeted_rep["confusion"] is None
+                    else round(targeted_rep["confusion"], 6)
+                ),
+                asr=(
+                    None if targeted_rep["asr"] is None
+                    else round(targeted_rep["asr"], 6)
+                ),
+                per_class={
+                    str(k): round(v, 6)
+                    for k, v in targeted_rep["per_class"].items()
+                },
+            )
     summary = {
         "final_accuracy": acc,
         # The last dispatch may have been a chunk: its loss carries a
@@ -816,6 +934,11 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
         "wall_s": time.time() - t_start,
         "train_wall_s": train_wall,
         "steps_per_sec": steps_done / train_wall if train_wall > 0 else None,
+        **({"targeted": {
+            "confusion": targeted_rep["confusion"],
+            "asr": targeted_rep["asr"],
+            "per_class": targeted_rep["per_class"],
+        }} if targeted_rep is not None else {}),
         **{f"step_{k}": v for k, v in timer.summary().items()},
     }
     print(json.dumps({"tag": tag, **summary}), flush=True)

@@ -32,7 +32,10 @@ def main(argv=None):
     )
     parser.add_argument(
         "--model_attack", type=str, default=None,
-        help="Byzantine model-gossip attack: random, reverse, drop.",
+        help="Byzantine model-gossip attack: random, reverse, drop; "
+             "lie, empire (collusion over the gossiped stack, DESIGN.md "
+             "§17); adaptive-lie, adaptive-empire (magnitude bisected "
+             "against the gossip quorum's admission).",
     )
     parser.add_argument(
         "--no_model_gossip", action="store_true",

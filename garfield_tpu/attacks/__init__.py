@@ -406,6 +406,47 @@ model_attacks = {
     "crash": model_crash_attack,
 }
 
+# --- model-plane collusion attacks (DESIGN.md §17) --------------------------
+#
+# The PAPERS.md attacks (lie = mu + z*sigma, empire = -eps*mu) are
+# gradient-plane INSTANCES of a strategy that works at any aggregation
+# point: hide inside the spread of whatever rows the rule aggregates. On
+# the model planes (ByzSGD's gather step, LEARN's gossip) the "cohort" a
+# Byzantine publisher hides inside is the WHOLE gathered replica stack —
+# unlike the gradient plane it need not simulate colluders, every row it
+# wants statistics over is handed to it by the protocol itself. These are
+# STACK-level attacks (they need the peers' rows), so they live beside
+# ``apply_model_attack_rows`` and are dispatched by it; the single-vector
+# ``apply_model_attack`` path (a lone Byzantine PS poisoning only its own
+# publish, no peer visibility at poison time) is served host-side by
+# apps/cluster.py keeping the previous round's gathered stack.
+
+
+def model_lie_attack_rows(models, mask, *, z=LIE_Z, **_):
+    """Model-plane little-is-enough: every Byzantine row publishes
+    ``mu + z*sigma`` with mu/sigma the coordinate-wise moments of ALL
+    gathered models (Bessel std, like the gradient twin)."""
+    mu = jnp.mean(models, axis=0)
+    n = models.shape[0]
+    var = jnp.sum((models - mu[None]) ** 2, axis=0) / (n - 1.0)
+    fake = mu + z * jnp.sqrt(var)
+    return jnp.where(mask[:, None], fake[None, :], models)
+
+
+def model_empire_attack_rows(models, mask, *, eps=EMPIRE_EPS, **_):
+    """Model-plane fall-of-empires: ``-eps * mu`` over the gathered
+    stack from every Byzantine row."""
+    fake = -eps * jnp.mean(models, axis=0)
+    return jnp.where(mask[:, None], fake[None, :], models)
+
+
+# Stack-form model attacks (need the gathered rows; the single-vector
+# dispatch below rejects them — a row-less call site has no cohort).
+model_collusion_attacks = {
+    "lie": model_lie_attack_rows,
+    "empire": model_empire_attack_rows,
+}
+
 
 def apply_model_attack(attack, model_vec, *, key=None, **params):
     """Poison a flattened model vector a Byzantine PS would serve
@@ -413,6 +454,12 @@ def apply_model_attack(attack, model_vec, *, key=None, **params):
     """
     if attack is None or attack == "none":
         return model_vec
+    if attack in model_collusion_attacks:
+        raise ValueError(
+            f"model attack {attack!r} is a collusion statistic over the "
+            "gathered stack; use apply_model_attack_rows (or the host "
+            "roles' last-gather path)"
+        )
     if attack not in model_attacks:
         raise ValueError(
             f"unknown model attack {attack!r}; available: {sorted(model_attacks)}"
@@ -431,10 +478,16 @@ def apply_model_attack_rows(attack, models, byz_mask, *, key=None, **params):
     The stack form of ``apply_model_attack`` shared by the model planes
     (LEARN gossip, ByzSGD gather step): row i is attacked with the key
     folded by its GLOBAL row index, so every shard derives identical
-    draws for the randomized attacks. None/"none" is passthrough.
+    draws for the randomized attacks. The collusion statistics
+    (lie/empire, DESIGN.md §17) are stack-only and dispatch here too.
+    None/"none" is passthrough.
     """
     if attack is None or attack == "none":
         return models
+    if attack in model_collusion_attacks:
+        return model_collusion_attacks[attack](
+            models, jnp.asarray(byz_mask, bool), **params
+        )
     if attack not in model_attacks:
         raise ValueError(
             f"unknown model attack {attack!r}; available: {sorted(model_attacks)}"

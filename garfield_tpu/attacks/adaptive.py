@@ -63,6 +63,8 @@ __all__ = [
     "active_mask_traced",
     "HostController",
     "delta_probe",
+    "model_fake",
+    "model_delta_probe",
     "read_selected",
 ]
 
@@ -493,6 +495,44 @@ def delta_probe(prev_flat, new_flat, fake_excess, mu_est=None, *,
         return True, 0.0
     score = float(np.dot(d / dn, u))
     return bool(score < threshold), score
+
+
+def model_fake(base, stack, magnitude):
+    """The model-plane collusion fake from an observed (k, d) replica/
+    gossip stack: ``mu + z*sigma`` (lie) or ``-eps*mu`` (empire) at the
+    controller's magnitude — the host twin of
+    ``attacks.model_lie_attack_rows``/``model_empire_attack_rows``, fed
+    by whatever stack the Byzantine publisher last GATHERED (a PS sees
+    every replica model in the gather step; a LEARN node sees its gossip
+    quorum). numpy in, numpy out (host roles only; the in-graph twins
+    call the row attacks with a traced magnitude directly)."""
+    stack = np.asarray(stack, np.float32)
+    mu = stack.mean(axis=0)
+    if base == "empire":
+        return (-float(magnitude) * mu).astype(np.float32)
+    sigma = stack.std(axis=0, ddof=1)  # NaN at k=1, like the gradient twin
+    return (mu + float(magnitude) * sigma).astype(np.float32)
+
+
+def model_delta_probe(prev_mean, new_mean, fake_excess, honest_delta=None,
+                      *, threshold=0.05):
+    """Published-MODEL fate from the next round's gathered plane.
+
+    The model-plane mirror of ``delta_probe``: a Byzantine publisher that
+    entered its peers' model aggregation pulls every honest replica's
+    model TOWARD its fake — so across one round the mean of the honest
+    peers' models moves by ``alpha * (fake - mu) + honest_drift``. The
+    probe projects that forward delta onto the attacker's own excess
+    direction after removing the honest-drift estimate (the attacker's
+    honest loop knows its own round delta). Implemented by calling
+    ``delta_probe`` with the arguments swapped — its ``prev - new``
+    convention then yields the forward delta. Returns
+    ``(detected, score)`` with the same semantics.
+    """
+    return delta_probe(
+        new_mean, prev_mean, fake_excess, mu_est=honest_delta,
+        threshold=threshold,
+    )
 
 
 def read_selected(path, rank, *, tail_bytes=262144):

@@ -1,0 +1,237 @@
+"""Targeted (semantic) poisoning: label flips and pixel-trigger backdoors.
+
+Every attack in ``attacks/__init__.py`` and ``attacks/adaptive.py`` is an
+UNTARGETED divergence attack: the adversary wants the aggregate far from
+the honest mean, and the whole defense stack — Gram distances, suspicion
+scores, the escalation ladder — keys on exactly that displacement. A
+TARGETED adversary wants something the divergence audit cannot see: a
+specific misclassification (source class read as target class), or a
+backdoor (any input carrying a small trigger pattern read as the target
+class), while global accuracy — and therefore the aggregate's distance to
+the honest mean — stays essentially untouched. The colluding cohort
+poisons its own BATCHES, not its gradient algebra:
+
+  - ``labelflip``: every cohort sample of class ``source`` is relabeled
+    ``target`` (``poison_frac`` of them). The resulting gradient is a
+    perfectly honest gradient *of the poisoned task* — in-distribution,
+    inside the honest spread for most coordinates, invisible to a
+    divergence test (the blindness the per-class eval telemetry of
+    TELEMETRY.md v8 exists to expose).
+  - ``backdoor``: ``poison_frac`` of the cohort's samples get a constant
+    TRIGGER stamped into a fixed input region (a corner patch on image
+    tasks, the leading features on flat/tabular tasks) and the label set
+    to ``target`` — BadNets-style. Success is measured as the
+    attack-success-rate (ASR): the fraction of non-target test inputs
+    that flip to ``target`` once the trigger is stamped
+    (``parallel.targeted_eval``), not as top-1 accuracy.
+
+One config + two poisoners serve every deployment scale: the traced
+``poison_batch`` rewrites the Byzantine slots' (x, y) device batches
+inside the jit'd step (the on-mesh topologies), and the same function on
+numpy arrays poisons a real Byzantine process's own shard
+(apps/cluster.py workers and LEARN nodes). Honest slots' batches are
+returned untouched, and ``attack=None`` paths never call in here — the
+defense-off bitwise contract is structural.
+"""
+
+import dataclasses
+
+import numpy as np
+
+__all__ = [
+    "TARGETED_ATTACKS",
+    "TargetedConfig",
+    "is_targeted",
+    "configure",
+    "poison_batch",
+    "apply_trigger",
+]
+
+TARGETED_ATTACKS = ("labelflip", "backdoor")
+
+
+def is_targeted(attack):
+    """True when ``attack`` names a targeted (data-poisoning) attack."""
+    return isinstance(attack, str) and attack in TARGETED_ATTACKS
+
+
+@dataclasses.dataclass(frozen=True)
+class TargetedConfig:
+    """Static plan of one targeted attack (both deployment scales).
+
+    ``source``/``target`` are class ids; ``labelflip`` relabels source
+    samples as target, ``backdoor`` stamps the trigger and relabels ANY
+    poisoned sample as target (source is ignored there — a backdoor wants
+    every triggered input misread). ``poison_frac`` is the fraction of
+    each cohort batch poisoned (1.0 for labelflip's classic form: every
+    source sample flips). ``trigger_value`` is the constant written into
+    the trigger region; ``trigger_size`` its side length (pixels on image
+    tasks, features on flat inputs). ``binary`` marks the single-logit
+    (pima) task, where the only classes are {0, 1} and the per-class
+    telemetry degrades to the binary confusion (reported once via the
+    ``attack_fallback``-style event — see ``configure``).
+    """
+
+    attack: str
+    source: int
+    target: int
+    poison_frac: float = 1.0
+    trigger_value: float = 2.5
+    trigger_size: int = 2
+    binary: bool = False
+
+    def __post_init__(self):
+        if self.attack not in TARGETED_ATTACKS:
+            raise ValueError(
+                f"unknown targeted attack {self.attack!r}; available: "
+                f"{TARGETED_ATTACKS}"
+            )
+        if self.source == self.target:
+            raise ValueError(
+                f"targeted attack needs source != target, got both "
+                f"{self.source}"
+            )
+        if not (0.0 < self.poison_frac <= 1.0):
+            raise ValueError(
+                f"poison_frac must be in (0, 1], got {self.poison_frac}"
+            )
+        if self.trigger_size < 1:
+            raise ValueError(
+                f"trigger_size must be >= 1, got {self.trigger_size}"
+            )
+
+
+def configure(attack, params, *, num_classes):
+    """``TargetedConfig`` from an attack name + CLI ``attack_params``.
+
+    Recognized params (all optional): ``source`` (default 0), ``target``
+    (default 1), ``poison_frac``, ``trigger_value``, ``trigger_size``.
+    ``num_classes`` is the model head's class count
+    (``models.num_classes_dict``); 1 marks the binary single-logit task
+    (pima), whose only classes are {0, 1} — a source/target outside that
+    range is refused loudly, and the binary degradation of the per-class
+    telemetry is reported ONCE via ``note_attack_fallback`` instead of
+    silently no-opping (the satellite contract).
+    """
+    if not is_targeted(attack):
+        raise ValueError(f"{attack!r} is not a targeted attack")
+    p = dict(params or {})
+    source = int(p.get("source", 0))
+    target = int(p.get("target", 1))
+    binary = int(num_classes) <= 1
+    hi = 2 if binary else int(num_classes)
+    for name, cls in (("source", source), ("target", target)):
+        if not (0 <= cls < hi):
+            raise ValueError(
+                f"targeted {name} class {cls} out of range [0, {hi}) for "
+                f"this dataset"
+            )
+    if binary:
+        from . import note_attack_fallback
+
+        note_attack_fallback(
+            attack, path="binary",
+            why="dataset has no multi-class labels plumbed (binary "
+                "surrogate); classes restricted to {0, 1} and the "
+                "per-class eval digest degrades to the binary confusion",
+        )
+    return TargetedConfig(
+        attack=attack,
+        source=source,
+        target=target,
+        poison_frac=float(p.get("poison_frac", 1.0)),
+        trigger_value=float(p.get("trigger_value", 2.5)),
+        trigger_size=int(p.get("trigger_size", 2)),
+        binary=binary,
+    )
+
+
+def _xp_of(x):
+    import jax
+
+    if isinstance(x, jax.Array):
+        import jax.numpy as jnp
+
+        return jnp
+    return np
+
+
+def apply_trigger(cfg, x):
+    """Stamp the trigger pattern into a batch of inputs.
+
+    Image batches (..., H, W, C) get a ``trigger_size`` x ``trigger_size``
+    corner patch set to ``trigger_value`` (every channel); flat batches
+    (..., D) get their leading ``trigger_size`` features set. Works on
+    numpy arrays AND traced jnp values (pure indexing writes), preserving
+    dtype — the same function stamps the cohort's train batches and the
+    evaluation probes (``parallel.targeted_eval``), so train-time and
+    test-time triggers can never drift apart.
+    """
+    xp = _xp_of(x)
+    t = cfg.trigger_size
+    v = x.dtype.type(cfg.trigger_value) if xp is np else cfg.trigger_value
+    if x.ndim >= 3:
+        # (..., H, W, C) image layout: bottom-right corner patch.
+        if xp is np:
+            out = x.copy()
+            out[..., -t:, -t:, :] = v
+            return out
+        return x.at[..., -t:, -t:, :].set(v).astype(x.dtype)
+    # Flat/tabular layout: the leading features are the trigger slots.
+    t = min(t, x.shape[-1])
+    if xp is np:
+        out = x.copy()
+        out[..., :t] = v
+        return out
+    return x.at[..., :t].set(v).astype(x.dtype)
+
+
+def _poison_mask(cfg, n, seed):
+    """Deterministic per-sample poison mask: the first
+    ``round(poison_frac * n)`` positions of a seeded permutation. Derived
+    from ``seed`` alone so every colluder (and every replay) agrees."""
+    k = int(round(cfg.poison_frac * n))
+    if k >= n:
+        return np.ones(n, bool)
+    rng = np.random.default_rng(seed)
+    mask = np.zeros(n, bool)
+    mask[rng.permutation(n)[:k]] = True
+    return mask
+
+
+def poison_batch(cfg, x, y, *, seed=0):
+    """Poison ONE cohort batch: returns ``(x', y')``.
+
+    ``labelflip``: samples of class ``source`` (within the poisoned
+    subset) are relabeled ``target``; inputs untouched. ``backdoor``: the
+    poisoned subset gets the trigger stamped and the label set to
+    ``target`` regardless of its true class. Label arrays may be int
+    class ids (multi-class) or the binary float (..., 1) pima targets —
+    both are rewritten in their own dtype. Dual-backend (numpy for the
+    host-plane cohort loops, jnp for the traced in-graph slots); the
+    poison-subset mask is host-derived from ``seed`` (static under jit:
+    the per-(slot, batch) seed is known at trace time for the stacked
+    batch streams the topologies feed).
+    """
+    xp = _xp_of(y)
+    n = int(y.shape[0])
+    sub = _poison_mask(cfg, n, seed)
+    if xp is not np:
+        import jax.numpy as jnp
+
+        sub = jnp.asarray(sub)
+    label_shape = (n,) + (1,) * (y.ndim - 1)
+    sub_l = sub.reshape(label_shape)
+    tgt = xp.asarray(cfg.target, y.dtype) if xp is np else cfg.target
+    if cfg.attack == "labelflip":
+        is_src = y == y.dtype.type(cfg.source) if xp is np else (
+            y == cfg.source
+        )
+        y2 = xp.where(sub_l & is_src, tgt, y)
+        return x, y2.astype(y.dtype)
+    # backdoor: trigger + relabel the poisoned subset.
+    x_trig = apply_trigger(cfg, x)
+    sub_x = sub.reshape((n,) + (1,) * (x.ndim - 1))
+    x2 = xp.where(sub_x, x_trig, x)
+    y2 = xp.where(sub_l, tgt, y)
+    return x2.astype(x.dtype), y2.astype(y.dtype)

@@ -38,6 +38,7 @@ __all__ = [
     "EvalSet",
     "compute_accuracy",
     "compute_accuracy_async",
+    "targeted_eval",
 ]
 
 topologies = {
@@ -208,3 +209,86 @@ def compute_accuracy_async(state, eval_fn, test_batches, *, binary=False,
     t.exc = None
     t.start()
     return t
+
+
+def _eval_predictions(state, eval_fn, eval_set, x_transform=None):
+    """Host-side (predictions, labels) over an ``EvalSet`` (uniform stack
+    + ragged tail). ``x_transform`` optionally rewrites each input batch
+    (the backdoor trigger stamp) before the forward pass. Eval-time only
+    — one readback per call, never on the training path."""
+    preds, labels = [], []
+
+    def one(x, y):
+        if x_transform is not None:
+            x = x_transform(x)
+        logits = eval_fn(state, x)
+        if eval_set.binary:
+            p = (np.asarray(logits).reshape(-1) > 0.5).astype(np.int64)
+        else:
+            p = np.asarray(logits).argmax(-1).astype(np.int64).reshape(-1)
+        preds.append(p)
+        labels.append(np.asarray(y).reshape(-1).astype(np.int64))
+
+    for b in range(int(eval_set.xs.shape[0])):
+        one(eval_set.xs[b], eval_set.ys[b])
+    for x, y in eval_set.ragged:
+        one(x, y)
+    return np.concatenate(preds), np.concatenate(labels)
+
+
+def targeted_eval(state, eval_fn, eval_set, *, source, target,
+                  trigger_cfg=None):
+    """Per-class accuracy + targeted attack-success-rate (DESIGN.md §17).
+
+    The divergence-based audit plane is blind to a targeted attack —
+    global accuracy barely moves — so success is measured where the
+    adversary defined it:
+
+      - ``per_class``: top-1 accuracy per true class (the v8 per-class
+        eval digest; a labelflip shows up as a crater at ``source``);
+      - ``confusion``: P(pred == target | true == source) — the
+        labelflip attack-success-rate, whose CLEAN value is the baseline
+        the DEFBENCH bar is measured against;
+      - ``asr`` (only with ``trigger_cfg``, a ``targeted.TargetedConfig``
+        for the backdoor): the trigger is stamped on every NON-target
+        test input and ``asr`` is the fraction that flips to ``target``
+        — the BadNets success metric, computed with the SAME
+        ``apply_trigger`` the poisoned training batches used.
+
+    Returns a dict with those fields plus ``accuracy`` (global top-1).
+    ``eval_set`` must be a ``parallel.EvalSet``.
+    """
+    from ..attacks import targeted as targeted_lib
+
+    preds, labels = _eval_predictions(state, eval_fn, eval_set)
+    classes = sorted(int(c) for c in np.unique(labels))
+    per_class = {
+        int(c): float((preds[labels == c] == c).mean())
+        for c in classes if (labels == c).any()
+    }
+    src_mask = labels == int(source)
+    confusion = (
+        float((preds[src_mask] == int(target)).mean())
+        if src_mask.any() else None
+    )
+    asr = None
+    if trigger_cfg is not None:
+        t_preds, t_labels = _eval_predictions(
+            state, eval_fn, eval_set,
+            x_transform=lambda x: targeted_lib.apply_trigger(
+                trigger_cfg, jnp.asarray(x)
+            ),
+        )
+        non_target = t_labels != int(target)
+        asr = (
+            float((t_preds[non_target] == int(target)).mean())
+            if non_target.any() else None
+        )
+    return {
+        "accuracy": float((preds == labels).mean()),
+        "per_class": per_class,
+        "source": int(source),
+        "target": int(target),
+        "confusion": confusion,
+        "asr": asr,
+    }

@@ -39,6 +39,7 @@ from ..attacks import (
     apply_gradient_attack_tree,
     gradient_attacks,
     note_attack_fallback,
+    targeted as targeted_lib,
 )
 from ..telemetry import taps as taps_lib
 from . import core, fold, mesh as mesh_lib
@@ -248,6 +249,26 @@ def make_trainer(
     gar = _resolve_gar(gar)
     attack_params = dict(attack_params or {})
     gar_params = dict(gar_params or {})
+    # Targeted data poisoning (DESIGN.md §17): the Byzantine cohort's
+    # BATCHES are rewritten (label flips / trigger stamps) and its
+    # gradient rows stay HONEST gradients of the poisoned task — no row
+    # transform exists for the GAR paths to see, which is exactly the
+    # blindness the per-class eval telemetry measures.
+    targeted_cfg = None
+    if targeted_lib.is_targeted(attack):
+        if f < 1:
+            raise ValueError(
+                f"targeted attack {attack!r} needs f >= 1 poisoning "
+                "workers"
+            )
+        targeted_cfg = targeted_lib.configure(
+            attack, attack_params,
+            num_classes=getattr(module, "num_classes", 2),
+        )
+        if byz_mask is None:
+            byz_mask = core.default_byz_mask(num_workers, f)
+        attack = None  # the rows are honest; the poison is in the data
+        attack_params = {}
     # Adaptive attacks (DESIGN.md §16): resolve the controller config and
     # strip it down to the BASE attack + cleaned params; the magnitude is
     # supplied per step from the carried bracket, never from params.
@@ -461,6 +482,36 @@ def make_trainer(
         shard_idx = jax.lax.axis_index(axis)
         slot_ids = shard_idx * per_shard + jnp.arange(per_shard)
         drop_keys = jax.vmap(lambda i: jax.random.fold_in(drop_base, i))(slot_ids)
+
+        if targeted_cfg is not None:
+            # Targeted poisoning (DESIGN.md §17): rewrite the Byzantine
+            # slots' batches BEFORE the gradient pass — label flips /
+            # trigger stamps on their own data, honest gradients of the
+            # poisoned task afterwards. Honest slots' batches are
+            # selected back bitwise, and targeted_cfg None traces none
+            # of this (the defense-off bitwise contract).
+            byz_local = byz_mask[slot_ids]
+            xs_p, ys_p = [], []
+            for k in range(per_shard):
+                xk, yk = targeted_lib.poison_batch(
+                    targeted_cfg, x_local[k], y_local[k], seed=k
+                )
+                xs_p.append(xk)
+                ys_p.append(yk)
+            x_pois = jnp.stack(xs_p)
+            y_pois = jnp.stack(ys_p)
+            x_local = jnp.where(
+                byz_local.reshape(
+                    (per_shard,) + (1,) * (x_local.ndim - 1)
+                ),
+                x_pois, x_local,
+            )
+            y_local = jnp.where(
+                byz_local.reshape(
+                    (per_shard,) + (1,) * (y_local.ndim - 1)
+                ),
+                y_pois, y_local,
+            )
 
         # Unrolled (not vmapped) per-slot gradients: kills the 5-D relayout
         # tax of the logical-worker fold (core.per_slot_grads docstring).

@@ -49,10 +49,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import aggregators
 from ..attacks import (
+    adaptive as adaptive_lib,
     apply_gradient_attack,
     apply_gradient_attack_tree,
     apply_model_attack_rows,
     model_attacks,
+    model_collusion_attacks,
 )
 from ..telemetry import taps as taps_lib
 from . import core, fold, mesh as mesh_lib
@@ -90,6 +92,7 @@ def make_trainer(
     model_gar_params=None,
     num_iter=None,
     telemetry=False,
+    defense=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the MSMW topology.
 
@@ -142,6 +145,34 @@ def make_trainer(
     p) to the gradient rule; ``model_gar_params`` to the model-space rule
     (default: same as ``gar_params``, matching the shared-rule default).
 
+    ``ps_attack`` additionally accepts the model-plane COLLUSION attacks
+    (``lie``/``empire`` — mu + z*sigma / -eps*mu over the gathered replica
+    stack, DESIGN.md §17) and their ADAPTIVE controllers (``adaptive-lie``
+    / ``adaptive-empire``, attacks/adaptive.py): the lie/empire magnitude
+    becomes a bisection bracket carried in ``TrainState.attack_state``
+    (the same carry slot aggregathor's gradient-plane bracket uses —
+    this topology's adaptive adversary lives on the MODEL plane), fed
+    back each step by whether the Byzantine PS rows entered the model
+    gather's selection; ``ps_attack_params`` carries the controller knobs
+    (``f_pool``/``rotation``/``mag_min``/``mag_max``). The model plane is
+    the attack surface ByzSGD exists for — a Byzantine PS bisecting
+    against the fastest-subset model gather (``model_subset``) is the
+    gather step's worst case.
+
+    ``defense`` (aggregators/defense.py) deploys suspicion weighting on
+    BOTH planes: a dict with ``power``/``floor``/``halflife`` enables a
+    per-rank exclusion EMA for the n_w workers AND one for the n_ps
+    replicas, carried in ``TrainState.defense_state``, mapped through
+    ``defense.suspicion_weights`` and composed as row scales into the
+    gradient stacks (before the gradient rule) and the gathered model
+    stack (before the model rule) — the MSMW twin of the SSMW PS's
+    per-quorum weighting, covering the gradient plane *and* the model
+    plane the adaptive PS attacker targets. ``defense=None`` (default)
+    traces nothing: trajectories are bitwise the undefended ones. Rule
+    ESCALATION lives above the trainer (apps/common.py rebuilds the step
+    at level changes; the ladder swaps the GRADIENT rule only — the
+    model rule is pinned so the two planes' ladders stay independent).
+
     ``step_fn(state, x, y)``: ``x``/``y`` lead with ``num_workers`` sharded
     over ``axis``; state params/opt_state lead with ``num_ps`` sharded over
     ``ps_axis``.
@@ -190,7 +221,35 @@ def make_trainer(
     m_eff = model_subset if model_subset is not None else num_ps
     if num_ps > 1 or fps:
         _check_gar(model_gar, m_eff, fps)
-    if ps_attack is not None and ps_attack != "none" and ps_attack not in model_attacks:
+    from ..attacks import targeted as targeted_lib
+
+    if targeted_lib.is_targeted(attack):
+        raise ValueError(
+            f"targeted attack {attack!r} poisons worker BATCHES and is "
+            "deployed on the aggregathor topology in-graph (and on real "
+            "cluster workers via apps/cluster.py); the MSMW in-graph "
+            "twin does not support it"
+        )
+    # Adaptive MODEL-plane attacker (DESIGN.md §17): resolve the
+    # controller and strip it down to the base collusion attack; the
+    # magnitude is supplied per step from the carried bracket.
+    ps_adaptive_cfg = None
+    if adaptive_lib.is_adaptive(ps_attack):
+        if byz_ps_mask is not None:
+            raise ValueError(
+                "adaptive PS attacks derive their own Byzantine pool from "
+                'ps_attack_params ("f_pool"/"pool"); an explicit '
+                "byz_ps_mask would silently fight the rotation schedule"
+            )
+        ps_adaptive_cfg = adaptive_lib.configure(
+            ps_attack, ps_attack_params, num_workers=num_ps, f=fps
+        )
+        ps_attack = ps_adaptive_cfg.base
+        ps_attack_params = adaptive_lib.base_params(ps_attack_params)
+        byz_ps_mask = ps_adaptive_cfg.pool_mask()
+    if (ps_attack is not None and ps_attack != "none"
+            and ps_attack not in model_attacks
+            and ps_attack not in model_collusion_attacks):
         raise ValueError(f"unknown model attack {ps_attack!r}")
     if byz_worker_mask is None:
         byz_worker_mask = core.default_byz_mask(num_workers, fw if attack else 0)
@@ -207,6 +266,31 @@ def make_trainer(
     )
     byz_worker_mask = jnp.asarray(byz_worker_mask, bool)
     byz_ps_mask = jnp.asarray(byz_ps_mask, bool)
+    # Closed-loop defense (see docstring): normalized EMA/weighting knobs,
+    # the aggregathor convention. Defense routes the gradient plane
+    # through the flat path (the weighted rows are what the host-plane
+    # MSMW replicas aggregate; the sub-Gram weighted composition is
+    # aggregathor's specialty) — a defense-only cost.
+    d_power = d_floor = d_decay = None
+    if defense is not None:
+        from ..aggregators import defense as defense_lib
+
+        if granularity == "layer":
+            raise ValueError(
+                "the suspicion-weighted defense needs whole-model "
+                'selection evidence; granularity="layer" has no per-rank '
+                "verdict"
+            )
+        dd = dict(defense)
+        d_power = float(dd.pop("power", 2.0))
+        d_floor = float(dd.pop("floor", 0.1))
+        halflife = float(dd.pop("halflife", 16.0))
+        if dd:
+            raise ValueError(f"unknown defense keys {sorted(dd)}")
+        if halflife <= 0.0:
+            raise ValueError(f"defense halflife must be > 0, got {halflife}")
+        d_decay = float(0.5 ** (1.0 / halflife))
+        defense_lib.suspicion_weights([0.0], power=d_power, floor=d_floor)
     model_waiting = model_subset is not None and model_subset < num_ps
     # Per-PS model subsets compose onto the model Gram for Gram-form rules
     # (the gradient plane's sub-Gram fast path applied to the (n_ps, d)
@@ -228,7 +312,12 @@ def make_trainer(
     # True subsets force the flat path (dynamic per-leaf gathers measured
     # 3.5x slower); without them tree == flat on one chip and tree avoids
     # the per-PS flatten on real multi-chip meshes. See _tree_path_ok.
-    tree_ok = _tree_path_ok(tree_path, subset, num_workers, granularity, gar)
+    # The suspicion-weighted defense also routes flat: its row weights
+    # (and the selection feedback they need) are explicit there.
+    tree_ok = (
+        _tree_path_ok(tree_path, subset, num_workers, granularity, gar)
+        and defense is None
+    )
 
     def init_fn(key, example_x, seed_rng=None):
         params, model_state = init_worker(key, example_x)
@@ -238,19 +327,42 @@ def make_trainer(
         stack = lambda tree: jax.tree.map(
             lambda l: jnp.broadcast_to(l[None], (num_ps,) + l.shape), tree
         )
+        attack_state = None
+        if ps_adaptive_cfg is not None:
+            # The model-plane bisection bracket starts wide open; the
+            # first gathers ARE the controller's probes.
+            attack_state = jax.device_put(
+                adaptive_lib.init_state(ps_adaptive_cfg), repl
+            )
+        defense_state = None
+        if defense is not None:
+            # One carried exclusion EMA PER PLANE: the workers' gradient
+            # audit and the replicas' model-gather audit are independent
+            # suspicion histories (independent planes, DESIGN.md §17).
+            defense_state = jax.device_put({
+                "obs": jnp.zeros((num_workers,), jnp.float32),
+                "exc": jnp.zeros((num_workers,), jnp.float32),
+                "ps_obs": jnp.zeros((num_ps,), jnp.float32),
+                "ps_exc": jnp.zeros((num_ps,), jnp.float32),
+            }, repl)
         state = core.TrainState(
             step=jnp.zeros((), jnp.int32),
             params=jax.device_put(stack(params), ps_sharding),
             model_state=jax.device_put(model_state, repl),
             opt_state=jax.device_put(stack(opt_state), ps_sharding),
             rng=jax.device_put(key if seed_rng is None else seed_rng, repl),
+            attack_state=attack_state,
+            defense_state=defense_state,
         )
         return state.replace(step=jax.device_put(state.step, repl))
 
-    def _ps_slot_step(ps_id, params, opt_state, grads_stack, keys):
+    def _ps_slot_step(ps_id, params, opt_state, grads_stack, keys,
+                      row_weights=None):
         """One server's gradient phase: attack is already applied; sample this
         PS's own arrival subset, aggregate, update (server.py:112-159 +
-        update_model :277-287)."""
+        update_model :277-287). ``row_weights`` is the defense's suspicion
+        discount — composed after the subset, like the SSMW PS's quorum
+        weighting (DESIGN.md §16)."""
         sub_key, gar_key = keys
         gkey = jax.random.fold_in(gar_key, ps_id)
         stack = grads_stack
@@ -260,6 +372,10 @@ def make_trainer(
                 jax.random.fold_in(sub_key, ps_id), n, subset
             )
             stack = stack[sel]
+            if row_weights is not None:
+                row_weights = row_weights[sel]
+        if row_weights is not None:
+            stack = (stack * row_weights[:, None]).astype(stack.dtype)
         if granularity == "layer":
             aggr = core.segmented_aggregate(
                 lambda s, i: gar.unchecked(
@@ -283,6 +399,45 @@ def make_trainer(
         w_shard = jax.lax.axis_index(axis)
         ps_ids = ps_shard * per_ps + jnp.arange(per_ps)
         slot_ids = w_shard * per_w + jnp.arange(per_w)
+
+        # Closed-loop defense weights (DESIGN.md §16/§17): per-PLANE
+        # suspicion from the carried exclusion EMAs — one history for the
+        # n_w workers, an independent one for the n_ps replicas. Exactly
+        # 1.0 on clean histories (the weighted identity contract).
+        def_w = ps_def_w = None
+        if defense is not None:
+            susp_w = state.defense_state["exc"] / jnp.maximum(
+                state.defense_state["obs"], 1e-6
+            )
+            def_w = defense_lib.suspicion_weights(
+                susp_w, power=d_power, floor=d_floor
+            )
+            susp_ps = state.defense_state["ps_exc"] / jnp.maximum(
+                state.defense_state["ps_obs"], 1e-6
+            )
+            ps_def_w = defense_lib.suspicion_weights(
+                susp_ps, power=d_power, floor=d_floor
+            )
+
+        # Adaptive MODEL-plane controller (DESIGN.md §17): play the
+        # carried bracket's midpoint as the collusion magnitude, rotate
+        # the active replica cohort. Nothing here is traced when the PS
+        # attack is oblivious.
+        act_ps_mask = byz_ps_mask
+        eff_ps_params = ps_attack_params
+        ps_mag = None
+        p_lo = p_hi = None
+        if ps_adaptive_cfg is not None:
+            p_lo = state.attack_state["lo"]
+            p_hi = state.attack_state["hi"]
+            ps_mag = adaptive_lib.played_magnitude(p_lo, p_hi)
+            act_ps_mask = adaptive_lib.active_mask_traced(
+                ps_adaptive_cfg, state.step
+            )
+            eff_ps_params = dict(ps_attack_params)
+            eff_ps_params[
+                adaptive_lib.magnitude_key(ps_adaptive_cfg.base)
+            ] = ps_mag
 
         # --- gradient phase, vmapped over this shard's local PS slots -----
         def grads_for_ps(ps_local_idx, params, ms):
@@ -384,26 +539,35 @@ def make_trainer(
             )(stacks)
 
             new_params, new_opt = jax.vmap(
-                _ps_slot_step, in_axes=(0, 0, 0, 0, None)
+                _ps_slot_step, in_axes=(0, 0, 0, 0, None, None)
             )(ps_ids, state.params, state.opt_state, stacks,
-              (sub_key, gar_key))
-            if telemetry:
+              (sub_key, gar_key), def_w)
+            if telemetry or defense is not None:
                 def one_tap(ps_id, stack):
-                    # SAME (sel, key) derivation as _ps_slot_step, so the
-                    # tap audits exactly the quorum this PS aggregated.
+                    # SAME (sel, key, weight) derivation as _ps_slot_step,
+                    # so the tap audits exactly the (suspicion-weighted)
+                    # quorum this PS aggregated — the defense's feedback.
                     gkey = jax.random.fold_in(gar_key, ps_id)
                     if subset is not None and subset < num_workers:
                         sel = core.subset_indices(
                             jax.random.fold_in(sub_key, ps_id),
                             num_workers, subset,
                         )
+                        sub = stack[sel]
+                        if def_w is not None:
+                            sub = (sub * def_w[sel][:, None]).astype(
+                                sub.dtype
+                            )
                         bundle = taps_lib.compute_flat(
-                            gar.name, stack[sel], fw, key=gkey,
+                            gar.name, sub, fw, key=gkey,
                             params=gar_params,
                         )
                         return taps_lib.scatter(bundle, sel, num_workers)
+                    sub = stack
+                    if def_w is not None:
+                        sub = (sub * def_w[:, None]).astype(sub.dtype)
                     return taps_lib.compute_flat(
-                        gar.name, stack, fw, key=gkey, params=gar_params,
+                        gar.name, sub, fw, key=gkey, params=gar_params,
                     )
 
                 tap = taps_lib.mean_bundles(
@@ -414,6 +578,47 @@ def make_trainer(
         flat_models = core.flatten_rows(new_params)  # (per_ps, d)
         models = jax.lax.all_gather(flat_models, ps_axis, tiled=True)  # (n_ps, d)
         params0 = jax.tree.map(lambda l: l[0], new_params)
+        # Model-plane selection feedback (DESIGN.md §17): the rule's
+        # verdict over the SAME poisoned, weighted replica stack the
+        # gather consumes — what the adaptive PS controller bisects
+        # against and what feeds the replica-plane suspicion EMA. Under
+        # model_subset the bundle is the observer mean over every PS
+        # view, pmean'd so the carried state stays replicated.
+        ps_bundle = None
+        if defense is not None or ps_adaptive_cfg is not None:
+            poisoned_m = apply_model_attack_rows(
+                ps_attack, models, act_ps_mask, key=psatk_key,
+                **eff_ps_params,
+            )
+            if ps_def_w is not None:
+                poisoned_m = (poisoned_m * ps_def_w[:, None]).astype(
+                    poisoned_m.dtype
+                )
+            if model_waiting:
+                def one_mtap(ps_id):
+                    # SAME (sel, key) derivation as the gather below.
+                    sel = core.subset_indices(
+                        jax.random.fold_in(msub_key, ps_id), num_ps,
+                        model_subset,
+                    )
+                    mkey = jax.random.fold_in(mgar_key, ps_id)
+                    bundle = taps_lib.compute_flat(
+                        model_gar.name, poisoned_m[sel], fps, key=mkey,
+                        params=model_gar_params,
+                    )
+                    return taps_lib.scatter(bundle, sel, num_ps)
+
+                ps_bundle = taps_lib.mean_bundles(
+                    jax.vmap(one_mtap)(ps_ids)
+                )
+                ps_bundle = jax.tree.map(
+                    lambda l: jax.lax.pmean(l, ps_axis), ps_bundle
+                )
+            else:
+                ps_bundle = taps_lib.compute_flat(
+                    model_gar.name, poisoned_m, fps, key=mgar_key,
+                    params=model_gar_params,
+                )
         if model_waiting:
             # Reference-faithful wait-n-f on the model plane: each PS
             # aggregates only its own seeded fastest q_m peer models
@@ -437,22 +642,29 @@ def make_trainer(
                 base_models = models
                 if model_fold_plan is None:
                     base_models = apply_model_attack_rows(
-                        ps_attack, models, byz_ps_mask, key=psatk_key,
-                        **ps_attack_params,
+                        ps_attack, models, act_ps_mask, key=psatk_key,
+                        **eff_ps_params,
                     )
                 aggr_models = fold.folded_tree_aggregate_multi(
                     model_gar, model_fold_plan, base_models, f=fps,
                     keys=mkeys, gar_params=model_gar_params,
-                    subset_sels=sels,
+                    subset_sels=sels, row_weights=ps_def_w,
                 )  # (per_ps, d)
             else:
                 poisoned = apply_model_attack_rows(
-                    ps_attack, models, byz_ps_mask, key=psatk_key,
-                    **ps_attack_params,
+                    ps_attack, models, act_ps_mask, key=psatk_key,
+                    **eff_ps_params,
                 )
 
                 def one_ps(sel, mkey):
                     sub = poisoned[sel]
+                    if ps_def_w is not None:
+                        # Replica-plane suspicion discount composed after
+                        # the subset — the gather's rows enter the rule
+                        # weighted, like the gradient plane's quorum.
+                        sub = (sub * ps_def_w[sel][:, None]).astype(
+                            sub.dtype
+                        )
                     if granularity == "layer":
                         return core.segmented_aggregate(
                             lambda s, i: model_gar.unchecked(
@@ -476,9 +688,11 @@ def make_trainer(
             )
         else:
             models = apply_model_attack_rows(
-                ps_attack, models, byz_ps_mask, key=psatk_key,
-                **ps_attack_params,
+                ps_attack, models, act_ps_mask, key=psatk_key,
+                **eff_ps_params,
             )
+            if ps_def_w is not None:
+                models = (models * ps_def_w[:, None]).astype(models.dtype)
             if granularity == "layer":
                 aggr_model = core.segmented_aggregate(
                     lambda s, i: model_gar.unchecked(
@@ -513,41 +727,98 @@ def make_trainer(
         )
         new_ms = jax.tree.map(lambda l: jax.lax.pmean(l, ps_axis), new_ms)
 
-        metrics = {"loss": mean_loss}
-        if telemetry:
+        tap_full = None
+        if tap is not None:
             # Observer mean over ALL num_ps server views (the local slots
-            # were averaged where `tap` was built).
-            metrics["tap"] = jax.tree.map(
+            # were averaged where `tap` was built). pmean'd ONCE here so
+            # the defense's carried state — updated from it below — stays
+            # replicated across shards.
+            tap_full = jax.tree.map(
                 lambda l: jax.lax.pmean(l, ps_axis), tap
             )
+
+        # Adaptive feedback: was the active replica cohort admitted by
+        # the model gather? Majority-excluded among the OBSERVED
+        # colluders counts as detected; a round that observed none
+        # (cohort outside every model subset) holds the bracket.
+        new_attack_state = state.attack_state
+        ps_detected = None
+        if ps_adaptive_cfg is not None:
+            act_f = act_ps_mask.astype(jnp.float32) * ps_bundle["observed"]
+            cnt = jnp.sum(act_f)
+            admitted = jnp.sum(
+                (ps_bundle["selected"] > 0).astype(jnp.float32) * act_f
+            )
+            ps_detected = admitted * 2.0 < cnt
+            upd_lo, upd_hi = adaptive_lib.update_bracket(
+                p_lo, p_hi, ps_detected,
+                mag_min=ps_adaptive_cfg.mag_min,
+                mag_max=ps_adaptive_cfg.mag_max,
+                regrow=ps_adaptive_cfg.regrow,
+            )
+            hold = cnt == 0.0
+            new_attack_state = {
+                "lo": jnp.where(hold, p_lo, upd_lo),
+                "hi": jnp.where(hold, p_hi, upd_hi),
+            }
+
+        new_defense_state = state.defense_state
+        if defense is not None:
+            # The hub's exclusion law (observed minus admitted) carried
+            # as decayed EMAs, one pair PER PLANE — the in-graph twin of
+            # the two MetricsHub histories the cluster roles keep.
+            dec = jnp.float32(d_decay)
+            w_obs = tap_full["observed"]
+            w_ind = (tap_full["selected"] > 0).astype(jnp.float32) * w_obs
+            m_obs = ps_bundle["observed"]
+            m_ind = (ps_bundle["selected"] > 0).astype(jnp.float32) * m_obs
+            new_defense_state = {
+                "obs": state.defense_state["obs"] * dec + w_obs,
+                "exc": state.defense_state["exc"] * dec + (w_obs - w_ind),
+                "ps_obs": state.defense_state["ps_obs"] * dec + m_obs,
+                "ps_exc": state.defense_state["ps_exc"] * dec
+                + (m_obs - m_ind),
+            }
+
+        metrics = {"loss": mean_loss}
+        if telemetry and tap_full is not None:
+            metrics["tap"] = tap_full
+        if ps_adaptive_cfg is not None:
+            # Controller observability (schema v8 ``ps_attack_adapt``
+            # events via the app loop): the magnitude played on the model
+            # plane and whether the gather caught it this round.
+            metrics["ps_attack_mag"] = jnp.asarray(ps_mag, jnp.float32)
+            metrics["ps_attack_detected"] = ps_detected.astype(jnp.float32)
+        if defense is not None:
+            metrics["defense_w"] = def_w
+            metrics["ps_defense_w"] = ps_def_w
         return (
             state.replace(
                 step=state.step + 1,
                 params=new_params,
                 model_state=new_ms,
                 opt_state=new_opt,
+                attack_state=new_attack_state,
+                defense_state=new_defense_state,
             ),
             metrics,
         )
 
+    # Replicated carries for the model-plane controller bracket and the
+    # per-plane defense EMAs (None fields stay structurally absent, so
+    # oblivious/undefended programs are byte-identical to the pre-§17
+    # ones).
+    state_specs = core.TrainState(
+        step=P(), params=P(ps_axis), model_state=P(),
+        opt_state=P(ps_axis), rng=P(),
+        attack_state=(P() if ps_adaptive_cfg is not None else None),
+        defense_state=(P() if defense is not None else None),
+    )
     sharded_step = mesh_lib.shard_map(
         _local_step,
         mesh=mesh,
-        in_specs=(
-            core.TrainState(
-                step=P(), params=P(ps_axis), model_state=P(),
-                opt_state=P(ps_axis), rng=P(),
-            ),
-            P(axis),
-            P(axis),
-        ),
-        out_specs=(
-            core.TrainState(
-                step=P(), params=P(ps_axis), model_state=P(),
-                opt_state=P(ps_axis), rng=P(),
-            ),
-            P(),
-        ),
+        in_specs=(state_specs, P(axis), P(axis)),
+        out_specs=(state_specs, P()),
         check_vma=False,
     )
 

@@ -76,9 +76,13 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..attacks import (
+    adaptive as adaptive_lib,
     apply_gradient_attack,
     apply_gradient_attack_tree,
     apply_model_attack_rows,
+    model_attacks,
+    model_collusion_attacks,
+    note_attack_fallback,
 )
 from ..telemetry import taps as taps_lib
 from . import core, fold, mesh as mesh_lib
@@ -114,8 +118,31 @@ def make_trainer(
     num_iter=None,
     telemetry=False,
     staleness=None,
+    defense=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the LEARN topology.
+
+    ``model_attack`` additionally accepts the model-plane COLLUSION
+    attacks (``lie``/``empire`` over the gossiped stack, DESIGN.md §17)
+    and their ADAPTIVE controllers (``adaptive-lie``/``adaptive-empire``):
+    the gossip-poisoning magnitude becomes a bisection bracket carried in
+    ``TrainState.attack_state``, fed back each step by whether the
+    Byzantine nodes' gossiped models entered the model aggregation's
+    selection — the decentralized twin of byzsgd's Byzantine-PS
+    controller, attacking LEARN's plane-2 gossip.
+
+    ``defense`` (aggregators/defense.py) deploys suspicion weighting on
+    ALL THREE exchange phases: a dict with ``power``/``floor``/
+    ``halflife`` enables a per-node exclusion EMA carried in
+    ``TrainState.defense_state`` (fed by the phase-2 observer-mean
+    selection — node identity is shared across the planes, so one
+    history serves the gradient gather, every agreement round AND the
+    model gossip), mapped through ``defense.suspicion_weights`` and
+    composed into the SAME row-weight algebra as the per-phase staleness
+    discount (fold ``row_weights`` on Gram rules, explicit row scaling
+    elsewhere). ``defense=None`` (default) traces nothing — trajectories
+    are bitwise the undefended ones. Rule escalation lives above the
+    trainer (apps/common.py), which rebuilds the step at level changes.
 
     ``telemetry`` adds ``metrics["tap"]`` — the phase-2 gradient
     exchange's ``TapBundle`` (telemetry/taps.py). Under per-node
@@ -199,6 +226,57 @@ def make_trainer(
         raise ValueError(
             f"worker_momentum must be in [0, 1), got {worker_momentum}"
         )
+    from ..attacks import targeted as targeted_lib
+
+    if targeted_lib.is_targeted(attack):
+        raise ValueError(
+            f"targeted attack {attack!r} poisons worker BATCHES and is "
+            "deployed on the aggregathor topology in-graph (and on real "
+            "cluster workers/nodes via apps/cluster.py); the LEARN "
+            "in-graph twin does not support it"
+        )
+    # Adaptive GOSSIP poisoner (DESIGN.md §17): resolve the controller,
+    # keep the base collusion attack; the magnitude comes from the
+    # carried bracket each step.
+    model_adaptive_cfg = None
+    if adaptive_lib.is_adaptive(model_attack):
+        if not model_gossip:
+            raise ValueError(
+                "adaptive gossip attacks poison the phase-5 model gossip; "
+                "model_gossip=False leaves them nothing to attack"
+            )
+        if byz_mask is not None:
+            raise ValueError(
+                "adaptive gossip attacks derive their own Byzantine pool "
+                'from model_attack_params ("f_pool"/"pool"); an explicit '
+                "byz_mask would silently fight the rotation schedule"
+            )
+        model_adaptive_cfg = adaptive_lib.configure(
+            model_attack, model_attack_params, num_workers=num_nodes, f=f
+        )
+        model_attack = model_adaptive_cfg.base
+        model_attack_params = adaptive_lib.base_params(model_attack_params)
+        byz_mask = model_adaptive_cfg.pool_mask()
+    if (model_attack is not None and model_attack != "none"
+            and model_attack not in model_attacks
+            and model_attack not in model_collusion_attacks):
+        raise ValueError(f"unknown model attack {model_attack!r}")
+    # Closed-loop defense (see docstring): normalized knobs, the
+    # aggregathor convention.
+    d_power = d_floor = d_decay = None
+    if defense is not None:
+        from ..aggregators import defense as defense_lib
+
+        dd = dict(defense)
+        d_power = float(dd.pop("power", 2.0))
+        d_floor = float(dd.pop("floor", 0.1))
+        halflife = float(dd.pop("halflife", 16.0))
+        if dd:
+            raise ValueError(f"unknown defense keys {sorted(dd)}")
+        if halflife <= 0.0:
+            raise ValueError(f"defense halflife must be > 0, got {halflife}")
+        d_decay = float(0.5 ** (1.0 / halflife))
+        defense_lib.suspicion_weights([0.0], power=d_power, floor=d_floor)
     if byz_mask is None:
         byz_mask = core.default_byz_mask(
             num_nodes, f if (attack or model_attack) else 0
@@ -223,6 +301,19 @@ def make_trainer(
     gossip_tree_ok = grad_tree_ok and (
         model_attack in (None, "none") or model_fold_plan is not None
     )
+    if model_adaptive_cfg is not None:
+        # The traced-magnitude collusion fake is stack-level (flat gossip
+        # path only) — reported once so benches attribute the path.
+        note_attack_fallback(
+            f"adaptive-{model_adaptive_cfg.base}", path="where",
+            why="model-plane collusion poisons the flat gossip stack",
+        )
+    if defense is not None and gar.gram_select is None:
+        # Suspicion weights are row weights: they compose with the tree
+        # route only through the Gram algebra — non-Gram rules take the
+        # flat path, which weights rows explicitly (the staleness rule).
+        grad_tree_ok = False
+        gossip_tree_ok = False
 
     # Bounded-staleness emulation (see docstring). Normalized at build so
     # trivially-synchronous configs drop the machinery entirely — the step
@@ -313,6 +404,19 @@ def make_trainer(
                 ),
                 node_sharding,
             )
+        attack_state = None
+        if model_adaptive_cfg is not None:
+            # The gossip-magnitude bisection bracket starts wide open.
+            attack_state = jax.device_put(
+                adaptive_lib.init_state(model_adaptive_cfg), repl
+            )
+        defense_state = None
+        if defense is not None:
+            # Carried per-node exclusion EMA: clean history, weights 1.0.
+            defense_state = jax.device_put({
+                "obs": jnp.zeros((num_nodes,), jnp.float32),
+                "exc": jnp.zeros((num_nodes,), jnp.float32),
+            }, repl)
         return core.TrainState(
             step=jax.device_put(jnp.zeros((), jnp.int32), repl),
             params=jax.device_put(stack(params), node_sharding),
@@ -321,6 +425,8 @@ def make_trainer(
             rng=jax.device_put(key if seed_rng is None else seed_rng, repl),
             worker_mom=worker_mom,
             gar_state=gar_state,
+            attack_state=attack_state,
+            defense_state=defense_state,
         )
 
     def _local_step(state, x_local, y_local):
@@ -360,6 +466,49 @@ def make_trainer(
             if w is None:
                 return stack
             return (stack * w[:, None]).astype(stack.dtype)
+
+        # Closed-loop defense weights (DESIGN.md §16/§17): per-node
+        # suspicion from the carried exclusion EMA; exactly 1.0 on a
+        # clean history. ONE history serves all three phases — node
+        # identity is shared across the planes.
+        def_w = None
+        if defense is not None:
+            susp = state.defense_state["exc"] / jnp.maximum(
+                state.defense_state["obs"], 1e-6
+            )
+            def_w = defense_lib.suspicion_weights(
+                susp, power=d_power, floor=d_floor
+            )
+
+        def row_w_for(phase_id):
+            """Per-phase composed row weights: the bounded-staleness
+            discount times the defense's suspicion weight — the shared
+            row-scale algebra, so both ride the same fold/flat paths."""
+            w = stale_w_for(phase_id)
+            if def_w is None:
+                return w
+            return def_w if w is None else (
+                (w * def_w).astype(jnp.float32)
+            )
+
+        # Adaptive GOSSIP controller (DESIGN.md §17): the collusion
+        # magnitude played on the plane-2 model gossip is the carried
+        # bracket's midpoint; rotation picks this round's active nodes.
+        act_mask_m = byz_mask
+        eff_m_params = model_attack_params
+        m_mag = None
+        m_lo = m_hi = None
+        if model_adaptive_cfg is not None:
+            m_lo = state.attack_state["lo"]
+            m_hi = state.attack_state["hi"]
+            m_mag = adaptive_lib.played_magnitude(m_lo, m_hi)
+            act_mask_m = adaptive_lib.active_mask_traced(
+                model_adaptive_cfg, state.step
+            )
+            eff_m_params = dict(model_attack_params)
+            eff_m_params[
+                adaptive_lib.magnitude_key(model_adaptive_cfg.base)
+            ] = m_mag
 
         def node_subset_keys(key):
             """Per-node (sel, gar_key) for one exchange — the SAME key
@@ -533,7 +682,7 @@ def make_trainer(
             lambda l: jax.lax.all_gather(l, axis, tiled=True), grads_local
         )
 
-        stale_w2 = stale_w_for(0)
+        stale_w2 = row_w_for(0)
 
         def phase2(centers_tree, centers_rows):
             if grad_tree_ok:
@@ -565,18 +714,21 @@ def make_trainer(
             aggr_local = phase2(None, None)
 
         metrics_extra = {}
-        if telemetry:
+        grad_bundle = None
+        if telemetry or defense is not None:
             # Phase-2 audit tap: the poisoned gathered stack rebuilt with
             # the SAME atk_key the exchange used (CSE'd on the flat path;
             # the enabled-only extra pass on the tree/fold paths). cclip
             # taps here use the rule's median-init center — the per-node
             # carried centers differ across observers (taps.py caveats).
+            # With the defense on, this bundle is ALSO the feedback that
+            # updates the carried exclusion EMA below.
             stack0p = apply_gradient_attack(
                 attack, core.flatten_rows(gathered), byz_mask, key=atk_key,
                 **attack_params,
             )
-            # The tap audits the rows the rule consumed — staleness-
-            # weighted included (the aggregathor tap convention).
+            # The tap audits the rows the rule consumed — staleness- and
+            # suspicion-weighted included (the aggregathor convention).
             stack0p = weight_rows(stack0p, stale_w2)
             if waiting:
                 def one_tap(nid):
@@ -596,13 +748,15 @@ def make_trainer(
                 local_mean = taps_lib.mean_bundles(
                     jax.vmap(one_tap)(node_ids)
                 )
-                metrics_extra["tap"] = jax.tree.map(
+                grad_bundle = jax.tree.map(
                     lambda l: jax.lax.pmean(l, axis), local_mean
                 )
             else:
-                metrics_extra["tap"] = taps_lib.compute_flat(
+                grad_bundle = taps_lib.compute_flat(
                     gar.name, stack0p, f, key=sub_key, params=gar_params,
                 )
+            if telemetry:
+                metrics_extra["tap"] = grad_bundle
         if track_spread:
             metrics_extra["aggr_spread_pre"] = honest_spread(
                 aggr_rows_of(aggr_local)
@@ -632,7 +786,7 @@ def make_trainer(
                     new = tree_exchange(
                         served, fold_plan, akey, skey, attack, attack_params,
                         center_tree=aggr if gar.stateful_center else None,
-                        row_weights=stale_w_for(1 + r),
+                        row_weights=row_w_for(1 + r),
                     )
                     return jax.tree.map(
                         lambda a, b: jnp.where(r < rounds, a, b), new, aggr
@@ -646,7 +800,7 @@ def make_trainer(
                     served = apply_gradient_attack(
                         attack, served, byz_mask, key=akey, **attack_params
                     )
-                    served = weight_rows(served, stale_w_for(1 + r))
+                    served = weight_rows(served, row_w_for(1 + r))
                     new = local_aggregates(
                         served, skey,
                         centers=aggr if gar.stateful_center else None,
@@ -693,8 +847,9 @@ def make_trainer(
         # Deterministic model attacks (reverse/crash) fold like the
         # gradient plane; stateful rules center each node's clip on its OWN
         # model (the ClippedGossip recipe) instead of a per-call median.
+        new_attack_state = state.attack_state
         if model_gossip:
-            stale_wg = stale_w_for(0x5009)
+            stale_wg = row_w_for(0x5009)
             if gossip_tree_ok:
                 models_tree = jax.tree.map(
                     lambda l: jax.lax.all_gather(l, axis, tiled=True),
@@ -710,15 +865,75 @@ def make_trainer(
                 flat_models = core.flatten_rows(new_params)  # (per_n, d)
                 models = jax.lax.all_gather(flat_models, axis, tiled=True)
                 models = apply_model_attack_rows(
-                    model_attack, models, byz_mask, key=matk_key,
-                    **model_attack_params,
+                    model_attack, models, act_mask_m, key=matk_key,
+                    **eff_m_params,
                 )
                 # Gossip-plane staleness: a stale model's row is
                 # discounted like a stale gradient's — the robust rule
                 # then treats the down-scaled row as the outlier it is,
                 # and the fresh honest majority keeps its influence
-                # (DESIGN.md §15; the same composition as the PS plane).
+                # (DESIGN.md §15; the same composition as the PS plane;
+                # the defense's suspicion weight rides the same multiply).
                 models = weight_rows(models, stale_wg)
+                if model_adaptive_cfg is not None:
+                    # Gossip-plane selection feedback (DESIGN.md §17):
+                    # the rule's verdict over the SAME poisoned, weighted
+                    # stack the gossip aggregates — majority-excluded
+                    # among the observed active nodes means detected; a
+                    # round that observed none holds the bracket.
+                    if waiting:
+                        def one_mtap(nid):
+                            # SAME (sel, key) derivation as
+                            # node_aggregate over msub_key.
+                            sel_key, gkey = jax.random.split(
+                                jax.random.fold_in(msub_key, nid)
+                            )
+                            sel = core.subset_indices(
+                                sel_key, num_nodes, subset
+                            )
+                            bundle = taps_lib.compute_flat(
+                                gar.name, models[sel], f, key=gkey,
+                                params=gar_params,
+                            )
+                            return taps_lib.scatter(bundle, sel, num_nodes)
+
+                        gb = taps_lib.mean_bundles(
+                            jax.vmap(one_mtap)(node_ids)
+                        )
+                        gossip_bundle = jax.tree.map(
+                            lambda l: jax.lax.pmean(l, axis), gb
+                        )
+                    else:
+                        gossip_bundle = taps_lib.compute_flat(
+                            gar.name, models, f, key=msub_key,
+                            params=gar_params,
+                        )
+                    act_f = act_mask_m.astype(jnp.float32) * gossip_bundle[
+                        "observed"
+                    ]
+                    cnt = jnp.sum(act_f)
+                    admitted = jnp.sum(
+                        (gossip_bundle["selected"] > 0).astype(jnp.float32)
+                        * act_f
+                    )
+                    m_detected = admitted * 2.0 < cnt
+                    upd_lo, upd_hi = adaptive_lib.update_bracket(
+                        m_lo, m_hi, m_detected,
+                        mag_min=model_adaptive_cfg.mag_min,
+                        mag_max=model_adaptive_cfg.mag_max,
+                        regrow=model_adaptive_cfg.regrow,
+                    )
+                    hold = cnt == 0.0
+                    new_attack_state = {
+                        "lo": jnp.where(hold, m_lo, upd_lo),
+                        "hi": jnp.where(hold, m_hi, upd_hi),
+                    }
+                    metrics_extra["model_attack_mag"] = jnp.asarray(
+                        m_mag, jnp.float32
+                    )
+                    metrics_extra["model_attack_detected"] = (
+                        m_detected.astype(jnp.float32)
+                    )
                 aggr_models = local_aggregates(
                     models, msub_key,
                     centers=flat_models if gar.stateful_center else None,
@@ -731,6 +946,20 @@ def make_trainer(
                         for k in range(per_n)
                     ],
                 )
+
+        new_defense_state = state.defense_state
+        if defense is not None:
+            # The hub's exclusion law (observed minus admitted) carried
+            # as a decayed EMA — the in-graph twin of the node hub's
+            # windowed suspicion, fed by the phase-2 observer mean.
+            dec = jnp.float32(d_decay)
+            obs_v = grad_bundle["observed"]
+            ind_v = (grad_bundle["selected"] > 0).astype(jnp.float32) * obs_v
+            new_defense_state = {
+                "obs": state.defense_state["obs"] * dec + obs_v,
+                "exc": state.defense_state["exc"] * dec + (obs_v - ind_v),
+            }
+            metrics_extra["defense_w"] = def_w
 
         honest = (~byz_mask).astype(losses.dtype)[node_ids]
         loss_num = jax.lax.psum(jnp.sum(losses * honest), axis)
@@ -751,6 +980,8 @@ def make_trainer(
                 opt_state=new_opt,
                 worker_mom=new_mom,
                 gar_state=new_gar_state,
+                attack_state=new_attack_state,
+                defense_state=new_defense_state,
             ),
             {"loss": mean_loss, **metrics_extra},
         )
@@ -759,6 +990,8 @@ def make_trainer(
         step=P(), params=P(axis), model_state=P(), opt_state=P(axis), rng=P(),
         worker_mom=(P(axis) if worker_momentum is not None else None),
         gar_state=(P(axis) if gar.stateful_center else None),
+        attack_state=(P() if model_adaptive_cfg is not None else None),
+        defense_state=(P() if defense is not None else None),
     )
     sharded_step = mesh_lib.shard_map(
         _local_step,

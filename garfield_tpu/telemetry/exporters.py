@@ -75,8 +75,21 @@ SCHEMA = "garfield-telemetry"
 # rotated cohort cannot launder) plus the ``defense``/``attack_adapt``
 # digests, and the new ``defense_bench`` kind (DEFBENCH_r*'s
 # accuracy-cell rows). Older records still validate — consumers key on
-# field presence, not version.
-SCHEMA_VERSION = 7
+# field presence, not version. v8 (round 15, the full threat-model
+# matrix — DESIGN.md §17): the ``ps_attack_adapt`` EVENT (one MODEL-
+# plane adaptive-controller observation — a Byzantine PS bisecting
+# against the replica gather, or a LEARN node against the gossip; same
+# fields as ``attack_adapt`` plus an optional ``plane`` tag), the
+# ``targeted_eval`` EVENT (the per-class eval digest: per-class
+# accuracy, source→target confusion, backdoor attack-success-rate —
+# what makes a suspicion-blind targeted attack measurable), ``summary``
+# gained the optional ``targeted`` digest (events/last_confusion/
+# last_asr), ``defense_weights`` events and ``defense_escalate`` events
+# may carry a ``plane`` tag (gradient/model/gossip — the per-plane
+# ladder deployment), and ``defense_bench`` rows may carry ``plane``/
+# ``confusion``/``asr``/``clean_confusion`` (the plane column and the
+# targeted rows' success metric).
+SCHEMA_VERSION = 8
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
          "transfer_bench", "exchange_bench", "hier_bench", "span",
@@ -192,27 +205,59 @@ def validate_record(rec):
                     f"staleness.step must be a non-negative int, "
                     f"got {step!r}"
                 )
-        elif rec.get("event") == "attack_adapt":
-            # v7: one adaptive-controller observation (DESIGN.md §16).
+        elif rec.get("event") in ("attack_adapt", "ps_attack_adapt"):
+            # v7: one adaptive-controller observation (DESIGN.md §16);
+            # v8 adds the MODEL-plane twin ``ps_attack_adapt`` (a
+            # Byzantine PS vs the replica gather / a LEARN node vs the
+            # gossip) with an optional plane tag.
+            ev = rec["event"]
             if not _is_num(rec.get("magnitude")):
                 _fail(
-                    f"attack_adapt.magnitude must be a number, got "
+                    f"{ev}.magnitude must be a number, got "
                     f"{rec.get('magnitude')!r}"
                 )
             for key in ("lo", "hi"):
                 val = rec.get(key)
                 if val is not None and not _is_num(val):
                     _fail(
-                        f"attack_adapt.{key} must be a number or null, "
+                        f"{ev}.{key} must be a number or null, "
                         f"got {val!r}"
                     )
             det = rec.get("detected")
             if det is not None and not isinstance(det, bool) \
                     and not _is_num(det):
                 _fail(
-                    f"attack_adapt.detected must be a bool/number or "
+                    f"{ev}.detected must be a bool/number or "
                     f"null, got {det!r}"
                 )
+            plane = rec.get("plane")
+            if plane is not None and not isinstance(plane, str):
+                _fail(f"{ev}.plane must be a string or null, got {plane!r}")
+        elif rec.get("event") == "targeted_eval":
+            # v8: the per-class eval digest of a targeted-attack run —
+            # what the suspicion plane cannot see, made measurable.
+            for key in ("source", "target"):
+                val = rec.get(key)
+                if not isinstance(val, int) or isinstance(val, bool):
+                    _fail(
+                        f"targeted_eval.{key} must be an int, got {val!r}"
+                    )
+            for key in ("confusion", "asr", "accuracy"):
+                val = rec.get(key)
+                if val is not None and not _is_num(val):
+                    _fail(
+                        f"targeted_eval.{key} must be a number or null, "
+                        f"got {val!r}"
+                    )
+            pc = rec.get("per_class")
+            if pc is not None:
+                if not isinstance(pc, dict) or not all(
+                    _is_num(v) for v in pc.values()
+                ):
+                    _fail(
+                        f"targeted_eval.per_class must map classes to "
+                        f"numbers, got {pc!r}"
+                    )
         elif rec.get("event") == "defense_weights":
             # v7: the PS's per-round suspicion-weight vector.
             ws = rec.get("weights")
@@ -341,6 +386,24 @@ def validate_record(rec):
                         f"summary.defense.{key} must be a number or "
                         f"null, got {val!r}"
                     )
+        tgt = rec.get("targeted")
+        if tgt is not None:
+            # v8: the targeted-eval digest (hub.targeted_stats).
+            if not isinstance(tgt, dict):
+                _fail(f"summary.targeted must be an object, got {tgt!r}")
+            ev = tgt.get("events")
+            if not isinstance(ev, int) or isinstance(ev, bool) or ev < 0:
+                _fail(
+                    f"summary.targeted.events must be a non-negative "
+                    f"int, got {ev!r}"
+                )
+            for key in ("last_confusion", "last_asr"):
+                val = tgt.get(key)
+                if val is not None and not _is_num(val):
+                    _fail(
+                        f"summary.targeted.{key} must be a number or "
+                        f"null, got {val!r}"
+                    )
         st = rec.get("step_time")
         if st is not None:
             if not isinstance(st, dict):
@@ -461,8 +524,16 @@ def validate_record(rec):
                 _fail(
                     f"defense_bench.{key} must be an int or null, got {val!r}"
                 )
+        plane = rec.get("plane")
+        if plane is not None and not isinstance(plane, str):
+            _fail(
+                f"defense_bench.plane must be a string or null, got "
+                f"{plane!r}"
+            )
         for key in ("final_accuracy", "final_loss", "attack_magnitude",
-                    "wall_s"):
+                    "wall_s",
+                    # v8: the targeted rows' success metrics.
+                    "confusion", "asr", "clean_confusion"):
             val = rec.get(key)
             if val is not None and not _is_num(val):
                 _fail(

@@ -80,6 +80,12 @@ class MetricsHub:
             "rule": None,
         }
         self._attack_adapt = {"events": 0, "last_mag": None}
+        # Targeted-attack eval accounting (schema v8, DESIGN.md §17):
+        # folded from ``targeted_eval`` events — the per-class digest the
+        # divergence-blind suspicion plane cannot produce.
+        self._targeted = {
+            "events": 0, "last_confusion": None, "last_asr": None,
+        }
         # Optional streaming sink (a JsonlExporter): every record is
         # written as it is recorded — crash-safe for the cluster roles,
         # whose exchange threads emit events the training loop never sees.
@@ -284,11 +290,21 @@ class MetricsHub:
                     d["level"] = int(fields["level"])
                 if fields.get("rule") is not None:
                     d["rule"] = str(fields["rule"])
-            elif kind == "attack_adapt":
+            elif kind in ("attack_adapt", "ps_attack_adapt"):
+                # v8: the model-plane twin folds into the same digest —
+                # one adaptive adversary per run is the deployed shape,
+                # and the raw plane-tagged events stream to the sink.
                 a = self._attack_adapt
                 a["events"] += 1
                 if fields.get("magnitude") is not None:
                     a["last_mag"] = float(fields["magnitude"])
+            elif kind == "targeted_eval":
+                t = self._targeted
+                t["events"] += 1
+                if fields.get("confusion") is not None:
+                    t["last_confusion"] = float(fields["confusion"])
+                if fields.get("asr") is not None:
+                    t["last_asr"] = float(fields["asr"])
             elif kind == "hier_exclusion":
                 # The hierarchical reducer's per-client audit (aggregators/
                 # hierarchy.py): observed/selected weight vectors over the
@@ -397,6 +413,25 @@ class MetricsHub:
                 "deescalations": int(d["deescalations"]),
                 "level": d["level"],
                 "rule": d["rule"],
+            }
+
+    def targeted_stats(self):
+        """Targeted-eval digest (schema v8), or None when no
+        ``targeted_eval`` event was folded (untargeted runs)."""
+        with self._lock:
+            t = self._targeted
+            if not t["events"]:
+                return None
+            return {
+                "events": int(t["events"]),
+                "last_confusion": (
+                    None if t["last_confusion"] is None
+                    else round(t["last_confusion"], 6)
+                ),
+                "last_asr": (
+                    None if t["last_asr"] is None
+                    else round(t["last_asr"], 6)
+                ),
             }
 
     def attack_adapt_stats(self):
@@ -559,6 +594,7 @@ class MetricsHub:
         )
         defense = self.defense_stats()
         adapt = self.attack_adapt_stats()
+        targeted = self.targeted_stats()
         stale = self.staleness_stats()
         autos = self.autoscale_stats()
         wire_planes = self.wire_plane_counters()
@@ -594,6 +630,9 @@ class MetricsHub:
                 # digests (None on runs without those events).
                 defense=defense,
                 attack_adapt=adapt,
+                # schema v8: targeted-eval digest (None on untargeted
+                # runs — v7 consumers see nothing new).
+                targeted=targeted,
                 observed=(
                     None if self._observed is None
                     else np.round(self._observed, 3).tolist()

@@ -11,7 +11,10 @@ future round fails the suite. Covers every registered record kind,
 including the schema-v7 ``defense_bench`` rows (DEFBENCH_r*: the
 adaptive-attack / closed-loop-defense accuracy cells) and the v7
 event/summary additions (attack_adapt, defense_weights,
-defense_escalate, attack_fallback, suspicion_decayed).
+defense_escalate, attack_fallback, suspicion_decayed) — and the v8
+threat-model-matrix additions (ps_attack_adapt, targeted_eval,
+plane-tagged defense events, the DEFBENCH_r02 grid rows with
+plane/confusion/asr columns).
 
   python scripts/validate_artifacts.py            # repo root auto-found
   python scripts/validate_artifacts.py /some/repo

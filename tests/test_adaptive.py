@@ -360,3 +360,87 @@ class TestAttackFallbackEvent:
         finally:
             hub_lib.install(prev)
             reset_attack_fallback()
+
+
+class TestModelPlaneAdaptive:
+    """The model-plane halves (DESIGN.md §17): collusion fakes from the
+    GATHERED plane stack, the forward delta probe, and the in-graph
+    byzsgd/learn controllers carrying their brackets."""
+
+    def test_model_fake_lie_and_empire(self):
+        rng = np.random.default_rng(0)
+        stack = rng.normal(size=(5, 16)).astype(np.float32)
+        lie = adaptive.model_fake("lie", stack, 2.0)
+        np.testing.assert_allclose(
+            lie, stack.mean(0) + 2.0 * stack.std(0, ddof=1), rtol=1e-5
+        )
+        emp = adaptive.model_fake("empire", stack, 3.0)
+        np.testing.assert_allclose(emp, -3.0 * stack.mean(0), rtol=1e-5)
+
+    def test_model_delta_probe_directions(self):
+        rng = np.random.default_rng(1)
+        d = 64
+        u = rng.normal(size=d).astype(np.float64)
+        u /= np.linalg.norm(u)
+        drift = rng.normal(size=d) * 0.01
+        prev = rng.normal(size=d)
+        # Admitted: the peers' mean moved TOWARD the fake excess.
+        det, score = adaptive.model_delta_probe(
+            prev, prev + drift + 0.5 * u, 0.5 * u, honest_delta=drift
+        )
+        assert not det and score > 0.5
+        # Excluded: only honest drift in the forward delta.
+        det2, _ = adaptive.model_delta_probe(
+            prev, prev + drift, 0.5 * u, honest_delta=drift
+        )
+        assert det2
+
+    def test_byzsgd_model_bracket_converges(self):
+        from garfield_tpu.parallel import byzsgd
+
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = byzsgd.make_trainer(
+            module, loss, opt, "krum", num_workers=8, num_ps=5,
+            fw=1, fps=1,
+            ps_attack="adaptive-lie", ps_attack_params={"mag_max": 8.0},
+        )
+        state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+        lo0 = float(state.attack_state["lo"])
+        hi0 = float(state.attack_state["hi"])
+        for _ in range(10):
+            state, metrics = step_fn(state, x, y)
+        lo, hi = (float(state.attack_state[k]) for k in ("lo", "hi"))
+        # Real probes happened and the bracket moved off its init.
+        assert "ps_attack_mag" in metrics
+        assert (hi - lo) < (hi0 - lo0)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_learn_gossip_bracket_converges(self):
+        from garfield_tpu.parallel import learn
+
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = learn.make_trainer(
+            module, loss, opt, "krum", num_nodes=8, f=2,
+            model_attack="adaptive-lie",
+            model_attack_params={"mag_max": 8.0},
+        )
+        state = init_fn(jax.random.PRNGKey(1), xs[0, 0])
+        for _ in range(10):
+            state, metrics = step_fn(state, x, y)
+        lo, hi = (float(state.attack_state[k]) for k in ("lo", "hi"))
+        assert "model_attack_mag" in metrics
+        assert hi - lo < 8.0 - 0.25
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_adaptive_ps_attack_rejects_explicit_mask(self):
+        from garfield_tpu.parallel import byzsgd
+
+        module, loss, opt = _pima_setup()
+        with pytest.raises(ValueError, match="rotation schedule"):
+            byzsgd.make_trainer(
+                module, loss, opt, "krum", num_workers=8, num_ps=5,
+                fw=1, fps=1, ps_attack="adaptive-lie",
+                byz_ps_mask=np.array([False] * 4 + [True]),
+            )

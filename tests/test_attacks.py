@@ -146,3 +146,155 @@ class TestModelAttacks:
         )
         with pytest.raises(ValueError):
             attacks.apply_model_attack("bogus", m)
+
+
+class TestModelCollusionAttacks:
+    def test_model_lie_rows_hides_inside_spread(self):
+        models = _stack(n=6, d=8, seed=3)
+        mask = jnp.asarray([False] * 4 + [True, True])
+        out = attacks.apply_model_attack_rows("lie", models, mask, z=1.5)
+        mu = jnp.mean(models, axis=0)
+        var = jnp.sum((models - mu[None]) ** 2, axis=0) / (6 - 1.0)
+        expect = mu + 1.5 * jnp.sqrt(var)
+        np.testing.assert_allclose(np.asarray(out[4]), np.asarray(expect),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(out[:4]),
+                                      np.asarray(models[:4]))
+
+    def test_model_empire_rows(self):
+        models = _stack(n=5, d=8, seed=4)
+        mask = jnp.asarray([True] + [False] * 4)
+        out = attacks.apply_model_attack_rows("empire", models, mask,
+                                              eps=2.0)
+        expect = -2.0 * jnp.mean(models, axis=0)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(expect),
+                                   rtol=1e-6)
+
+    def test_single_vector_dispatch_rejects_collusion(self):
+        with pytest.raises(ValueError, match="collusion"):
+            attacks.apply_model_attack("lie", jnp.zeros(8))
+
+
+class TestTargeted:
+    def _cfg(self, **kw):
+        from garfield_tpu.attacks import targeted
+
+        p = dict(attack="labelflip", source=0, target=1)
+        p.update(kw)
+        return targeted.TargetedConfig(**p)
+
+    def test_labelflip_flips_only_source_labels(self):
+        from garfield_tpu.attacks import targeted
+
+        cfg = self._cfg()
+        x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+        y = np.array([0, 1, 2, 0, 1, 0, 2, 0], np.int32)
+        x2, y2 = targeted.poison_batch(cfg, x, y, seed=7)
+        np.testing.assert_array_equal(x2, x)  # inputs untouched
+        np.testing.assert_array_equal(
+            y2, np.where(y == 0, 1, y)
+        )  # poison_frac=1: every source sample flips, others untouched
+
+    def test_labelflip_binary_float_labels(self):
+        from garfield_tpu.attacks import targeted
+
+        cfg = self._cfg()
+        y = np.array([[0.0], [1.0], [0.0]], np.float32)
+        x = np.zeros((3, 8), np.float32)
+        _, y2 = targeted.poison_batch(cfg, x, y, seed=1)
+        np.testing.assert_array_equal(
+            y2, np.array([[1.0], [1.0], [1.0]], np.float32)
+        )
+        assert y2.dtype == np.float32
+
+    def test_backdoor_stamps_trigger_and_relabels(self):
+        from garfield_tpu.attacks import targeted
+
+        cfg = self._cfg(attack="backdoor", trigger_size=2,
+                        trigger_value=9.0)
+        x = np.zeros((4, 5, 5, 3), np.float32)
+        y = np.array([0, 2, 1, 2], np.int32)
+        x2, y2 = targeted.poison_batch(cfg, x, y, seed=0)
+        np.testing.assert_array_equal(y2, np.ones(4, np.int32))
+        # Bottom-right 2x2 patch set on every channel, rest untouched.
+        assert (x2[:, -2:, -2:, :] == 9.0).all()
+        assert (x2[:, :3, :, :] == 0.0).all()
+
+    def test_backdoor_poison_frac_subset_is_deterministic(self):
+        from garfield_tpu.attacks import targeted
+
+        cfg = self._cfg(attack="backdoor", poison_frac=0.5)
+        x = np.zeros((8, 6), np.float32)
+        y = np.zeros(8, np.int32)
+        x2a, y2a = targeted.poison_batch(cfg, x, y, seed=3)
+        x2b, y2b = targeted.poison_batch(cfg, x, y, seed=3)
+        np.testing.assert_array_equal(x2a, x2b)
+        np.testing.assert_array_equal(y2a, y2b)
+        assert int((y2a == 1).sum()) == 4  # exactly poison_frac * n
+
+    def test_traced_matches_numpy(self):
+        from garfield_tpu.attacks import targeted
+
+        cfg = self._cfg(attack="backdoor", poison_frac=0.5)
+        x = np.random.default_rng(1).normal(size=(6, 4)).astype(np.float32)
+        y = np.array([0, 1, 2, 0, 1, 2], np.int32)
+        xn, yn = targeted.poison_batch(cfg, x, y, seed=5)
+        xj, yj = jax.jit(
+            lambda a, b: targeted.poison_batch(cfg, a, b, seed=5)
+        )(jnp.asarray(x), jnp.asarray(y))
+        np.testing.assert_array_equal(np.asarray(xj), xn)
+        np.testing.assert_array_equal(np.asarray(yj), yn)
+
+    def test_configure_validates(self):
+        from garfield_tpu.attacks import targeted
+
+        with pytest.raises(ValueError, match="source != target"):
+            targeted.configure(
+                "labelflip", {"source": 1, "target": 1}, num_classes=10
+            )
+        with pytest.raises(ValueError, match="out of range"):
+            targeted.configure(
+                "labelflip", {"source": 12, "target": 1}, num_classes=10
+            )
+        with pytest.raises(ValueError, match="out of range"):
+            # Binary surrogate restricts classes to {0, 1}.
+            targeted.configure(
+                "backdoor", {"target": 3}, num_classes=1
+            )
+
+    def test_binary_surrogate_emits_one_fallback_event(self):
+        from garfield_tpu.attacks import targeted
+        from garfield_tpu.telemetry import hub as hub_lib
+
+        attacks.reset_attack_fallback()
+        hub = hub_lib.MetricsHub(num_ranks=4)
+        prev = hub_lib.install(hub)
+        try:
+            targeted.configure("labelflip", {}, num_classes=1)
+            targeted.configure("labelflip", {}, num_classes=1)  # once only
+        finally:
+            hub_lib.uninstall()
+            if prev is not None:
+                hub_lib.install(prev)
+        evs = [r for r in hub.records()
+               if r.get("event") == "attack_fallback"]
+        assert len(evs) == 1
+        assert evs[0]["attack"] == "labelflip"
+        assert "labels" in evs[0]["why"]
+        attacks.reset_attack_fallback()
+
+    def test_targeted_refused_on_learn_and_byzsgd_twins(self):
+        from garfield_tpu.models import select_model
+        from garfield_tpu.parallel import byzsgd, learn
+        from garfield_tpu.utils import selectors
+
+        module = select_model("pimanet", "pima")
+        loss = selectors.select_loss("bce")
+        opt = selectors.select_optimizer("sgd", lr=0.1, momentum=0.0,
+                                         weight_decay=0.0)
+        with pytest.raises(ValueError, match="aggregathor"):
+            learn.make_trainer(module, loss, opt, "krum", num_nodes=8,
+                               f=2, attack="labelflip")
+        with pytest.raises(ValueError, match="aggregathor"):
+            byzsgd.make_trainer(module, loss, opt, "krum", num_workers=8,
+                                num_ps=5, fw=2, fps=1, attack="backdoor")

@@ -355,3 +355,221 @@ class TestTrainerIntegration:
             state, metrics = step_fn(state, x, y)
         assert np.isfinite(float(metrics["loss"]))
         assert metrics["defense_w"].shape == (8,)
+
+
+class TestPlaneDefense:
+    """Host-side per-plane defense runtime (DESIGN.md §17): independent
+    decayed histories + independent ladders per aggregation plane."""
+
+    def _plan(self, escalate=True):
+        return defense.DefensePlan(
+            weighted=True, escalate=escalate, power=2.0, floor=0.1,
+            halflife=8.0,
+            escalation=defense.EscalationConfig(
+                theta_up=0.5, theta_down=0.2, patience=2, clean_window=8,
+            ),
+        )
+
+    def test_clean_history_weights_are_identity(self):
+        pd = defense.PlaneDefense(
+            self._plan(escalate=False), 8, f=2, plane="gradient",
+            base_gar="krum",
+        )
+        assert pd.weights_for([0, 1, 2]) is None
+        pd.fold([0, 1, 2, 3], [1.0, 1.0, 1.0, 1.0])  # all admitted
+        assert pd.weights_for([0, 1, 2, 3]) is None
+
+    def test_excluded_rank_loses_weight(self):
+        pd = defense.PlaneDefense(
+            self._plan(escalate=False), 8, f=2, plane="gradient",
+            base_gar="krum",
+        )
+        for _ in range(6):
+            pd.fold(list(range(8)), [1.0] * 7 + [0.0])
+        w = pd.weights_for(list(range(8)))
+        assert w is not None
+        assert w[7] < 1.0 and np.all(w[:7] == 1.0)
+
+    def test_per_plane_ladder_independence(self):
+        # The satellite pin: the GRADIENT plane escalates while the
+        # MODEL plane — a separate PlaneDefense with a clean history —
+        # stays at its starting level.
+        plan = self._plan()
+        grad = defense.PlaneDefense(
+            plan, 8, f=2, plane="gradient", base_gar="krum",
+        )
+        model = defense.PlaneDefense(
+            plan, 5, f=1, plane="model", base_gar="krum",
+        )
+        # Both ladders start at the level MATCHING the configured rule's
+        # semantics (repo-default krum == multi-krum; start_level).
+        start = defense.start_level(plan.escalation.levels, "krum")
+        assert grad.policy.level == model.policy.level == start == 1
+        for _ in range(6):
+            # Concentrated exclusions on the gradient plane only.
+            grad.fold(list(range(8)), [1.0] * 6 + [0.0, 0.0])
+            assert grad.observe() in (0, 1)
+            # The model plane's quorums stay clean.
+            model.fold(list(range(5)), [1.0] * 5)
+            assert model.observe() == 0
+        assert grad.policy.level > start
+        assert grad.current()[0] == "bulyan"
+        assert model.policy.level == start
+        assert model.current() == ("krum", {})
+
+    def test_start_level_matches_semantics_not_names(self):
+        lv = defense.DEFAULT_LEVELS
+        # Repo-default krum (m = n - f - 2) IS the multi-krum level; a
+        # name match at classic krum would DOWNGRADE the deployed rule.
+        assert defense.start_level(lv, "krum") == 1
+        assert defense.start_level(lv, "krum", {"m": 1}) == 0
+        assert defense.start_level(lv, "bulyan") == 2
+        assert defense.start_level(lv, "median") == 0
+
+    def test_escalate_needs_ladder_rule(self):
+        with pytest.raises(ValueError, match="escalation-ladder"):
+            defense.PlaneDefense(
+                self._plan(), 8, f=2, plane="gossip", base_gar="hier-krum",
+            )
+
+    def test_revert_undoes_infeasible_level(self):
+        pd = defense.PlaneDefense(
+            self._plan(), 8, f=2, plane="gradient", base_gar="krum",
+        )
+        start = pd.policy.level
+        for _ in range(4):
+            pd.fold(list(range(8)), [1.0] * 6 + [0.0, 0.0])
+            act = pd.observe()
+            if act:
+                pd.revert(act)
+        assert pd.policy.level == start
+
+
+class TestPlaneTwinsInGraph:
+    """The in-graph twins' defense deployment (parallel/byzsgd,
+    parallel/learn): clean-start identity weights, defense-off bitwise
+    purity, per-plane metrics."""
+
+    def test_byzsgd_defense_off_is_bitwise_undefended(self):
+        from garfield_tpu.parallel import byzsgd
+
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        runs = []
+        for d in (None, None):
+            init_fn, step_fn, _ = byzsgd.make_trainer(
+                module, loss, opt, "krum", num_workers=8, num_ps=5,
+                fw=2, fps=1, attack="lie", defense=d,
+            )
+            state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+            for _ in range(4):
+                state, metrics = step_fn(state, x, y)
+            runs.append((_flat_params(state), float(metrics["loss"])))
+        np.testing.assert_array_equal(runs[0][0], runs[1][0])
+        assert runs[0][1] == runs[1][1]
+
+    def test_byzsgd_first_step_weights_are_identity(self):
+        from garfield_tpu.parallel import byzsgd
+
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = byzsgd.make_trainer(
+            module, loss, opt, "krum", num_workers=8, num_ps=5,
+            fw=2, fps=1, attack="lie", defense={"halflife": 8.0},
+        )
+        state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+        state, metrics = step_fn(state, x, y)
+        # Clean-start contract: no history yet, every weight EXACTLY 1.0
+        # on BOTH planes (the defense-off identity, weighted half).
+        np.testing.assert_array_equal(
+            np.asarray(metrics["defense_w"]), np.ones(8, np.float32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(metrics["ps_defense_w"]), np.ones(5, np.float32)
+        )
+        # And the per-plane EMAs are carried, plane-shaped.
+        assert np.asarray(state.defense_state["obs"]).shape == (8,)
+        assert np.asarray(state.defense_state["ps_obs"]).shape == (5,)
+
+    def test_learn_defense_weights_all_three_phases(self):
+        from garfield_tpu.parallel import learn
+
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        init_fn, step_fn, _ = learn.make_trainer(
+            module, loss, opt, "krum", num_nodes=8, f=2,
+            attack="reverse", non_iid=True, defense={"halflife": 4.0},
+        )
+        state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+        for _ in range(8):
+            state, metrics = step_fn(state, x, y)
+        assert np.isfinite(float(metrics["loss"]))
+        w = np.asarray(metrics["defense_w"])
+        assert w.shape == (8,)
+        # reverse rows are excluded every phase-2 round: the Byzantine
+        # nodes' carried suspicion must dominate and floor their weight.
+        susp = (
+            np.asarray(state.defense_state["exc"])
+            / np.maximum(np.asarray(state.defense_state["obs"]), 1e-6)
+        )
+        assert susp[6:].min() > susp[:6].max()
+
+    def test_learn_defense_off_is_bitwise_undefended(self):
+        from garfield_tpu.parallel import learn
+
+        module, loss, opt = _pima_setup()
+        xs, x, y = _pima_batches(8, 16)
+        runs = []
+        for d in (None, None):
+            init_fn, step_fn, _ = learn.make_trainer(
+                module, loss, opt, "krum", num_nodes=8, f=2,
+                attack="lie", model_attack="reverse", defense=d,
+            )
+            state = init_fn(jax.random.PRNGKey(3), xs[0, 0])
+            for _ in range(4):
+                state, metrics = step_fn(state, x, y)
+            runs.append(_flat_params(state))
+        np.testing.assert_array_equal(runs[0], runs[1])
+
+
+class TestSchemaV8:
+    def test_ps_attack_adapt_and_targeted_eval_validate(self):
+        tele_fmt.validate_record(tele_fmt.make_record(
+            "event", event="ps_attack_adapt", step=3, magnitude=1.25,
+            detected=True, lo=0.5, hi=2.0, plane="model",
+        ))
+        tele_fmt.validate_record(tele_fmt.make_record(
+            "event", event="targeted_eval", step=10, source=0, target=1,
+            accuracy=0.91, confusion=0.12, asr=0.4,
+            per_class={"0": 0.9, "1": 0.92},
+        ))
+
+    def test_summary_targeted_digest_validates(self):
+        hub = hub_lib.MetricsHub(num_ranks=4)
+        hub.record_event(
+            "targeted_eval", source=0, target=1, confusion=0.2, asr=0.5,
+        )
+        s = hub.summary()
+        assert s["targeted"] == {
+            "events": 1, "last_confusion": 0.2, "last_asr": 0.5,
+        }
+        tele_fmt.validate_record(s)
+
+    def test_malformed_v8_events_rejected(self):
+        with pytest.raises(ValueError):
+            tele_fmt.validate_record(tele_fmt.make_record(
+                "event", event="ps_attack_adapt", magnitude="big",
+            ))
+        with pytest.raises(ValueError):
+            tele_fmt.validate_record(tele_fmt.make_record(
+                "event", event="targeted_eval", source="a", target=1,
+            ))
+        with pytest.raises(ValueError):
+            tele_fmt.validate_record(tele_fmt.make_record(
+                "event", event="targeted_eval", source=0, target=1,
+                per_class={"0": "high"},
+            ))
+        with pytest.raises(ValueError):
+            tele_fmt.validate_record(tele_fmt.make_record(
+                "defense_bench", cell="x", gar="krum", plane=7,
+            ))

@@ -176,3 +176,144 @@ def test_onmesh_cli_closed_loop(tmp_path):
     assert s["attack_adapt"]["events"] == 40
     assert s["defense"] is not None and s["defense"]["rounds"] == 40
     assert s["suspicion_decayed"] is not None
+
+
+def test_learn_per_plane_defense_with_adaptive_gossip_node(tmp_path):
+    """6 LEARN nodes, one a real adaptive-lie GOSSIP poisoner
+    (--model_attack adaptive-lie: collusion fake over its last gathered
+    gossip stack, forward delta-probe feedback), every honest node
+    running --defense escalate with INDEPENDENT per-plane ladders
+    (DESIGN.md §17). Every role must exit rc 0, the attacker must close
+    real probes, and an honest node's stream must carry plane-tagged
+    defense events."""
+    from garfield_tpu.utils import multihost
+
+    n = 6
+    pp = _ports(n)
+    cfg_path = str(tmp_path / "cluster.json")
+    multihost.generate_config(
+        cfg_path, nodes=[f"127.0.0.1:{p}" for p in pp],
+        task_type="node", task_index=0,
+    )
+    env = _env()
+    base = [
+        sys.executable, "-m", "garfield_tpu.apps.learn",
+        "--cluster", cfg_path,
+        "--dataset", "pima", "--model", "pimanet", "--loss", "bce",
+        "--batch", "16", "--fw", "1", "--gar", "krum",
+        "--num_iter", "10", "--acc_freq", "0",
+        "--opt_args", '{"lr":"0.05"}',
+        "--cluster_timeout_ms", "120000",
+    ]
+    tele = str(tmp_path / "tele")
+    procs = []
+    for k in range(n):
+        argv = base + ["--task", f"node:{k}"]
+        if k == n - 1:
+            argv += ["--model_attack", "adaptive-lie",
+                     "--model_attack_params", '{"mag_max": 4.0}']
+        else:
+            argv += ["--defense", "escalate",
+                     "--suspicion_halflife", "8",
+                     "--telemetry", tele]
+        procs.append(subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    for k, p in enumerate(procs):
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+        assert p.returncode == 0, f"node {k} failed:\n{out[-2000:]}"
+    # The attacker ran REAL probes through the gossip delta channel.
+    atk = json.loads(
+        [l for l in outs[-1].splitlines() if l.startswith("{")][-1]
+    )
+    assert atk["model_attack_adapt"]["probes"] > 0
+    # An honest node's stream carries plane-tagged defense evidence for
+    # BOTH planes (independent histories).
+    recs = [
+        json.loads(l)
+        for l in open(os.path.join(tele, "cluster-node-0.telemetry.jsonl"))
+    ]
+    planes = {
+        r.get("plane") for r in recs
+        if r.get("event") == "defense_weights"
+    }
+    esc_planes = {
+        r.get("plane") for r in recs
+        if r.get("event") == "defense_escalate"
+    }
+    assert planes <= {"gradient", "gossip"}
+    assert esc_planes <= {"gradient", "gossip"}
+    # Every record (v8 events included) is schema-valid.
+    from garfield_tpu.telemetry import validate_jsonl
+
+    validate_jsonl(os.path.join(tele, "cluster-node-0.telemetry.jsonl"))
+
+
+def test_msmw_defense_and_adaptive_byzantine_ps(tmp_path):
+    """3 PS replicas (one a real adaptive-lie Byzantine PS probing the
+    replica gather) + 6 workers (one labelflip): the honest replicas run
+    the MSMW gradient-plane defense; everyone exits rc 0 and the
+    Byzantine PS closes real model-plane probes."""
+    from garfield_tpu.utils import multihost
+
+    n_ps, n_w = 3, 6
+    pp = _ports(n_ps + n_w)
+    cfg_path = str(tmp_path / "cluster.json")
+    multihost.generate_config(
+        cfg_path,
+        ps=[f"127.0.0.1:{p}" for p in pp[:n_ps]],
+        workers=[f"127.0.0.1:{p}" for p in pp[n_ps:]],
+        task_type="ps", task_index=0,
+    )
+    env = _env()
+    tele = str(tmp_path / "tele")
+    base = [
+        sys.executable, "-m", "garfield_tpu.apps.byzsgd",
+        "--cluster", cfg_path,
+        "--dataset", "pima", "--model", "pimanet", "--loss", "bce",
+        "--batch", "16", "--fw", "1", "--fps", "1",
+        "--gar", "krum", "--model_gar", "median",
+        "--num_iter", "10", "--acc_freq", "0",
+        "--opt_args", '{"lr":"0.05"}',
+        "--cluster_timeout_ms", "120000",
+    ]
+    procs = []
+    for k in range(n_ps):
+        argv = base + ["--task", f"ps:{k}"]
+        if k == n_ps - 1:
+            argv += ["--ps_attack", "adaptive-lie",
+                     "--ps_attack_params", '{"mag_max": 4.0}']
+        else:
+            argv += ["--defense", "escalate",
+                     "--suspicion_halflife", "8", "--telemetry", tele]
+        procs.append(("ps", k, subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )))
+    for k in range(n_w):
+        argv = base + ["--task", f"worker:{k}"]
+        if k == n_w - 1:
+            argv += ["--attack", "labelflip"]
+        procs.append(("worker", k, subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )))
+    byz_out = None
+    for role, k, p in procs:
+        out, _ = p.communicate(timeout=600)
+        assert p.returncode == 0, f"{role} {k} failed:\n{out[-2000:]}"
+        if role == "ps" and k == n_ps - 1:
+            byz_out = out
+    atk = json.loads(
+        [l for l in byz_out.splitlines() if l.startswith("{")][-1]
+    )
+    assert atk["ps_attack_adapt"]["probes"] > 0
+    # Honest replica telemetry: gradient-plane defense weights landed.
+    recs = [
+        json.loads(l)
+        for l in open(os.path.join(tele, "cluster-ps-0.telemetry.jsonl"))
+    ]
+    assert any(r.get("event") == "defense_weights" for r in recs)

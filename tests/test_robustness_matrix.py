@@ -166,3 +166,148 @@ def test_permutation_invariant_under_attack(rule):
     a1 = np.asarray(gars[rule].unchecked(attacked, f=F))
     a2 = np.asarray(gars[rule].unchecked(attacked[perm], f=F))
     np.testing.assert_allclose(a1, a2, rtol=2e-5, atol=2e-6)
+
+
+# --- model-plane adaptive rows (DESIGN.md §17) ------------------------------
+#
+# The same closed loop on the MODEL plane: a Byzantine PS publishes the
+# model-plane collusion fake (mu + z*sigma over the replica stack it
+# gathered) into its peers' fastest-subset model gather (byzsgd
+# ``model_subset``); feedback is whether the fake reached the observers'
+# aggregates. The rule must bound the adapted MODEL aggregate exactly
+# like the gradient plane's.
+
+N_PS, F_PS, Q_M = 7, 1, 5  # krum needs q_m >= 2f + 3
+
+
+def _adaptive_model_rounds(rule, T=48):
+    from garfield_tpu.attacks import adaptive, apply_model_attack_rows
+
+    cfg = adaptive.configure(
+        "adaptive-lie", {"mag_max": 8.0}, num_workers=N_PS, f=F_PS
+    )
+    lo, hi = cfg.mag_min, cfg.mag_max
+    rng = np.random.default_rng(zlib.crc32(f"model-{rule}".encode()))
+    mu = np.ones(D, np.float32)
+    mask = jnp.arange(N_PS) >= N_PS - F_PS
+    errs, max_admitted = [], 0.0
+    for t in range(T):
+        models = mu + SIGMA * rng.standard_normal(
+            (N_PS, D)
+        ).astype(np.float32)
+        z = float(adaptive.played_magnitude(lo, hi))
+        attacked = apply_model_attack_rows(
+            "lie", jnp.asarray(models), mask, z=z
+        )
+        # Per-observer fastest-subset gathers (model_subset): every
+        # honest PS aggregates its own seeded q_m of n_ps models.
+        key = jax.random.PRNGKey(t)
+        fracs, aggs = [], []
+        hm = models[: N_PS - F_PS].mean(axis=0)
+        u = np.asarray(attacked[N_PS - 1]) - hm
+        for obs in range(N_PS - F_PS):
+            sel = np.asarray(jax.random.permutation(
+                jax.random.fold_in(key, obs), N_PS
+            ))[:Q_M]
+            agg = np.asarray(
+                gars[rule].unchecked(attacked[jnp.asarray(sel)], f=F_PS)
+            )
+            aggs.append(agg)
+            if N_PS - 1 in sel:
+                fracs.append(float(
+                    np.dot(agg - hm, u) / max(np.dot(u, u), 1e-12)
+                ))
+        detected = (not fracs) or (np.mean(fracs) < 0.05)
+        if not detected and fracs:
+            max_admitted = max(max_admitted, z)
+        lo, hi = (float(v) for v in adaptive.update_bracket(
+            lo, hi, detected, mag_min=cfg.mag_min, mag_max=cfg.mag_max,
+        ))
+        errs.append(max(
+            float(np.linalg.norm(a - mu)) for a in aggs
+        ))
+    return errs, max_admitted, (lo, hi)
+
+
+@pytest.mark.parametrize("rule", ["krum", "median"])
+def test_adaptive_model_plane_stays_bounded(rule):
+    """The model-plane contract: under per-observer model subsets the
+    adaptive PS's collusion fake never drives any honest observer's
+    model aggregate outside the matrix tolerance, while the bisection
+    genuinely converges on the rule's admission threshold."""
+    errs, max_admitted, (lo, hi) = _adaptive_model_rounds(rule)
+    tol = 5 * SIGMA * np.sqrt(D)
+    assert all(np.isfinite(errs))
+    assert max(errs) <= tol, (
+        f"model/{rule}: adapted fake broke the bound "
+        f"({max(errs):.4f} > {tol:.4f})"
+    )
+    assert hi - lo < 4.0, f"model/{rule}: bracket never converged"
+
+
+# --- targeted rows (DESIGN.md §17) ------------------------------------------
+
+
+@pytest.mark.parametrize("attack", ["labelflip", "backdoor"])
+def test_targeted_attack_raises_asr_not_divergence(attack):
+    """The targeted family's defining property, as a trained row: the
+    poisoned cohort measurably raises the per-class attack-success-rate
+    (source→target confusion / trigger ASR — parallel.targeted_eval)
+    while the aggregate stays non-divergent (finite, training still
+    converges on the untargeted classes) — the blindness of the
+    divergence-based audit made measurable."""
+    import os
+
+    import jax as _jax
+    from garfield_tpu import data as data_lib, parallel
+    from garfield_tpu.attacks import targeted as targeted_lib
+    from garfield_tpu.models import select_model
+    from garfield_tpu.parallel import aggregathor
+    from garfield_tpu.utils import selectors
+
+    os.environ["GARFIELD_SURROGATE_MARGIN"] = "1.35"
+    try:
+        data_lib._warned_synthetic.clear()
+        module = select_model("pimanet", "pima")
+        loss = selectors.select_loss("bce")
+        opt = selectors.select_optimizer(
+            "sgd", lr=0.1, momentum=0.0, weight_decay=0.0
+        )
+        m = data_lib.DatasetManager("pima", 8, 8, 8, 0)
+        m.num_ps = 0
+        xs, ys = m.sharded_train_batches()
+        test = parallel.EvalSet(m.get_test_set(), binary=True)
+        params = {"source": 0, "target": 1, "poison_frac": 1.0}
+        cfg = targeted_lib.configure(attack, params, num_classes=1)
+        rates = {}
+        for atk in (None, attack):
+            init_fn, step_fn, eval_fn = aggregathor.make_trainer(
+                module, loss, opt, "average", num_workers=8, f=3,
+                attack=atk, attack_params=params if atk else {},
+            )
+            state = init_fn(_jax.random.PRNGKey(0), xs[0, 0])
+            nb = xs.shape[1]
+            for i in range(150):
+                b = i % nb
+                state, metrics = step_fn(
+                    state, jnp.asarray(xs[:, b]), jnp.asarray(ys[:, b])
+                )
+            assert np.isfinite(float(metrics["loss"]))
+            rep = parallel.targeted_eval(
+                state, eval_fn, test, source=0, target=1,
+                trigger_cfg=cfg if attack == "backdoor" else None,
+            )
+            rates[atk] = (
+                rep["asr"] if attack == "backdoor" else rep["confusion"]
+            )
+            # Non-divergence: the poisoned run still classifies the
+            # TARGET class fine (it only moved the source boundary).
+            assert rep["per_class"][1] > 0.5
+        # The ASR bar: the poisoned run's success rate clearly exceeds
+        # the clean confusion baseline.
+        assert rates[attack] > rates[None] + 0.05, (
+            f"{attack}: ASR {rates[attack]} vs clean {rates[None]}"
+        )
+    finally:
+        os.environ.pop("GARFIELD_SURROGATE_MARGIN", None)
+        data_lib._warned_synthetic.clear()
