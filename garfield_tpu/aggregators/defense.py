@@ -398,26 +398,53 @@ class DefensePlan:
     floor: float = 0.1
     halflife: float = 16.0
     escalation: EscalationConfig = None
+    # Data-plane detectors (aggregators/dataplane.py, DESIGN.md §18):
+    # the third plane of the closed loop — per-class head-gradient
+    # fingerprints + spectral/2-means detection, their own EMA halflife.
+    data: bool = False
+    dp_tau: float = 2.0
+    dp_power: float = 4.0
+    dp_floor: float = 0.0
+    dp_halflife: float = 8.0
 
     def policy(self):
         return EscalationPolicy(self.escalation) if self.escalate else None
+
+
+# --defense mode table: (weighted, escalate, data). The GAR-side modes
+# compose with the data plane via "+data" — the two defenses run
+# SIMULTANEOUSLY (independent evidence, one row-weight algebra), which
+# is how DEFBENCH_r03's backdoor bar is met without giving up the
+# adaptive-lie coverage the ladder provides.
+DEFENSE_MODES = {
+    "weighted": (True, False, False),
+    "escalate": (True, True, False),
+    "data": (False, False, True),
+    "weighted+data": (True, False, True),
+    "escalate+data": (True, True, True),
+}
 
 
 def resolve(args):
     """``DefensePlan`` from the CLI flags, or None when ``--defense`` is
     off. ``--defense weighted`` enables suspicion weighting alone;
     ``--defense escalate`` enables weighting AND the rule ladder (the
-    full closed loop). ``--defense_params`` tunes ``power``/``floor``/
-    ``halflife`` (the in-graph suspicion EMA's decay) and the escalation
-    knobs (``levels``/``theta_up``/``theta_down``/``patience``/
-    ``clean_window``)."""
+    full closed loop); ``--defense data`` enables the DATA-plane
+    detectors alone (fingerprints + spectral/2-means — the only plane
+    that sees a backdoor); ``weighted+data``/``escalate+data`` compose
+    them. ``--defense_params`` tunes ``power``/``floor``/``halflife``
+    (the suspicion EMA), the escalation knobs (``levels``/``theta_up``/
+    ``theta_down``/``patience``/``clean_window``), and the data-plane
+    knobs (``dp_tau``/``dp_power``/``dp_floor``/``dp_halflife``)."""
     mode = getattr(args, "defense", None)
     if not mode or mode == "none":
         return None
-    if mode not in ("weighted", "escalate"):
+    if mode not in DEFENSE_MODES:
         raise SystemExit(
-            f"unknown --defense mode {mode!r}; use weighted or escalate"
+            f"unknown --defense mode {mode!r}; use one of "
+            f"{sorted(DEFENSE_MODES)}"
         )
+    weighted, escalate, data = DEFENSE_MODES[mode]
     p = dict(getattr(args, "defense_params", None) or {})
     esc = EscalationConfig(
         levels=tuple(p.pop("levels", DEFAULT_LEVELS)),
@@ -427,12 +454,17 @@ def resolve(args):
         clean_window=int(p.pop("clean_window", 12)),
     )
     plan = DefensePlan(
-        weighted=True,
-        escalate=(mode == "escalate"),
+        weighted=weighted,
+        escalate=escalate,
         power=float(p.pop("power", 2.0)),
         floor=float(p.pop("floor", 0.1)),
         halflife=float(p.pop("halflife", 16.0)),
         escalation=esc,
+        data=data,
+        dp_tau=float(p.pop("dp_tau", 2.0)),
+        dp_power=float(p.pop("dp_power", 4.0)),
+        dp_floor=float(p.pop("dp_floor", 0.0)),
+        dp_halflife=float(p.pop("dp_halflife", 8.0)),
     )
     if p:
         raise SystemExit(f"unknown --defense_params keys {sorted(p)}")

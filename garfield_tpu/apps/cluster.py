@@ -63,7 +63,11 @@ import numpy as np
 import optax
 from jax.flatten_util import ravel_pytree
 
-from ..aggregators import defense as defense_lib, gars
+from ..aggregators import (
+    dataplane as dataplane_lib,
+    defense as defense_lib,
+    gars,
+)
 from ..parallel import core
 from ..telemetry import hub as tele_hooks, trace as tele_trace
 from ..utils import multihost, rounds, tools, wire
@@ -1104,6 +1108,21 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
         args, "cluster-ps", num_ranks=n_w,
         meta={"attack": getattr(args, "attack", None), "q": q},
     )
+    # Data-plane defense (aggregators/dataplane.py, DESIGN.md §18): the
+    # host twin of the on-mesh detectors — fingerprints the wire frames
+    # this PS already decodes, carries its own decayed flag EMA, and
+    # composes per-quorum weights into the same row-scale slot as the
+    # staleness/suspicion discounts.
+    dp_def = None
+    if defense_plan is not None and defense_plan.data:
+        dp_def = dataplane_lib.DataPlaneDefense(
+            n_w, dataplane_lib.head_spec(params0),
+            f=max(1, f), plane="gradient",
+            tau=defense_plan.dp_tau, power=defense_plan.dp_power,
+            floor=defense_plan.dp_floor,
+            halflife=defense_plan.dp_halflife,
+        )
+
     def _build_tap(g, gp):
         from ..telemetry import taps as taps_lib
 
@@ -1310,7 +1329,35 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
                         bn_mean = _robust_stats(
                             np.stack([rows[k][1] for k in quorum]), f
                         )
-                if defense_plan is not None and tele_hub is not None:
+                if dp_def is not None:
+                    # Data-plane detectors (DESIGN.md §18): fingerprint
+                    # this quorum's decoded rows, fold the flags into
+                    # the dp EMA, and compose by CENTER-PULL — suspect
+                    # rows collapse onto the quorum's trusted-mean center
+                    # (toward-zero scaling would hand the cohort krum
+                    # centrality; dataplane.center_pull_rows). The host
+                    # twin of the in-graph dataplane block.
+                    qidx = [k - worker_ranks[0] for k in quorum]
+                    rep = dp_def.observe(
+                        qidx, np.asarray(stack, np.float32)
+                    )
+                    tele_hooks.emit_event(
+                        "data_defense", who="cluster-ps", step=int(i),
+                        plane="gradient",
+                        ranks=[int(x) for x in qidx],
+                        scores=[round(float(s), 6)
+                                for s in rep["scores"]],
+                        flags=[int(x) for x in rep["flags"]],
+                        weights=[round(float(x), 6) for x in
+                                 dp_def.weights_full()[qidx]],
+                    )
+                    w_dp = dp_def.weights_for(qidx)
+                    if w_dp is not None:
+                        stack = dataplane_lib.center_pull_rows(
+                            stack, jnp.asarray(w_dp)
+                        )
+                if defense_plan is not None and defense_plan.weighted \
+                        and tele_hub is not None:
                     # Suspicion weighting (DESIGN.md §16): the quorum's
                     # rows enter the GAR scaled by their ranks' decayed,
                     # median-relative suspicion — composed with the
@@ -1814,6 +1861,21 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
         meta={"attack": getattr(args, "attack", None), "q": q,
               "fps": int(fps), "model_gar": model_gar_name},
     )
+    # Data-plane defense on the MSMW GRADIENT quorums (DESIGN.md §18):
+    # each replica runs its own detector history over the worker frames
+    # it decodes — the per-plane independence convention (the model
+    # gather is an agreement over replica MODELS; fingerprinting applies
+    # to the worker gradient plane only).
+    dp_def = None
+    if defense_plan is not None and defense_plan.data:
+        dp_def = dataplane_lib.DataPlaneDefense(
+            n_w, dataplane_lib.head_spec(params0),
+            f=max(1, f), plane="gradient",
+            tau=defense_plan.dp_tau, power=defense_plan.dp_power,
+            floor=defense_plan.dp_floor,
+            halflife=defense_plan.dp_halflife,
+        )
+
     def _build_tap(g, gp):
         if tele_hub is None:
             return None
@@ -2014,7 +2076,29 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
                     bn = 0.5 * (bn_plane + _robust_stats(
                         np.stack([rows[k][1] for k in quorum]), f
                     ))
-            if defense_plan is not None and tele_hub is not None:
+            if dp_def is not None:
+                # Data-plane detectors (DESIGN.md §18): the SSMW PS's
+                # per-quorum composition verbatim — detect, fold the
+                # EMA, center-pull suspect rows onto the trusted mean —
+                # against this replica's own detector history.
+                qidx = [k - worker_ranks[0] for k in quorum]
+                rep = dp_def.observe(qidx, np.asarray(stack, np.float32))
+                tele_hooks.emit_event(
+                    "data_defense", who=who, step=int(i),
+                    plane="gradient",
+                    ranks=[int(x) for x in qidx],
+                    scores=[round(float(s), 6) for s in rep["scores"]],
+                    flags=[int(x) for x in rep["flags"]],
+                    weights=[round(float(x), 6) for x in
+                             dp_def.weights_full()[qidx]],
+                )
+                w_dp = dp_def.weights_for(qidx)
+                if w_dp is not None:
+                    stack = dataplane_lib.center_pull_rows(
+                        stack, jnp.asarray(w_dp)
+                    )
+            if defense_plan is not None and defense_plan.weighted \
+                    and tele_hub is not None:
                 # Suspicion weighting on the MSMW gradient plane
                 # (DESIGN.md §17): the SSMW PS's per-quorum composition
                 # verbatim — decayed median-relative suspicion from this
@@ -2285,6 +2369,17 @@ def _run_learn(args):
     # jits are cached per rule like the SSMW PS's.
     defense_plan = defense_lib.resolve(args)
     grad_def = gossip_def = None
+    if defense_plan is not None and defense_plan.data:
+        # The data-plane detectors deploy on the PS gradient quorums
+        # (SSMW/MSMW) and the on-mesh SSMW step (DESIGN.md §18); a LEARN
+        # node's per-phase quorums keep the GAR-side ladder only.
+        tools.warning(
+            f"[cluster-node-{cfg.task_index}] --defense data: the "
+            "data-plane detectors are a PS-quorum deployment; LEARN "
+            "nodes apply the GAR-side defense components only"
+        )
+        if not defense_plan.weighted and not defense_plan.escalate:
+            defense_plan = None
     if defense_plan is not None:
         if not getattr(args, "telemetry", None):
             args.telemetry = "telemetry"
@@ -2791,7 +2886,7 @@ def _run_learn(args):
 
                         xb, yb = targeted_lib.poison_batch(
                             targeted_cfg, np.asarray(xb), np.asarray(yb),
-                            seed=me,
+                            seed=me, step=i,
                         )
                     g, _, ms = worker_grad(
                         flat_dev, ms, xb, yb,
@@ -3436,7 +3531,7 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
 
                     xb, yb = targeted_lib.poison_batch(
                         targeted_cfg, np.asarray(xb), np.asarray(yb),
-                        seed=windex,
+                        seed=windex, step=step,
                     )
                 g, loss_, ms_new = worker_grad(
                     flat_params, ms, xb, yb, key,

@@ -74,17 +74,26 @@ def base_parser(description, *, default_model="convnet", default_loss="nll"):
            '"burst": 6.0}; targeted knobs: {"source": 0, "target": 1, '
            '"poison_frac": 1.0, "trigger_size": 2, "trigger_value": 2.5}).')
     a("--defense", type=str, default=None,
-      choices=["none", "weighted", "escalate"],
-      help="Closed-loop defense (aggregators/defense.py, DESIGN.md §16): "
-           "'weighted' scales each rank's rows by its (decayed) suspicion "
-           "before the GAR; 'escalate' adds the rule ladder "
+      choices=["none", "weighted", "escalate", "data", "weighted+data",
+               "escalate+data"],
+      help="Closed-loop defense (aggregators/defense.py, DESIGN.md §16/"
+           "§18): 'weighted' scales each rank's rows by its (decayed) "
+           "suspicion before the GAR; 'escalate' adds the rule ladder "
            "(krum -> multi-krum -> bulyan) driven by suspicion "
-           "concentration, with hysteresis. Off (default): the vanilla "
-           "rule — trajectories bitwise unchanged.")
+           "concentration, with hysteresis; 'data' adds the DATA-plane "
+           "detectors (aggregators/dataplane.py: per-class classifier-"
+           "head gradient fingerprints, spectral filtering + 2-means "
+           "cohort clustering — the only plane that sees a backdoor/"
+           "labelflip cohort, whose gradients are divergence-invisible); "
+           "'weighted+data'/'escalate+data' run the GAR-side and "
+           "data-plane defenses simultaneously. Off (default): the "
+           "vanilla rule — trajectories bitwise unchanged.")
     a("--defense_params", type=json.loads, default={},
       help="Defense knobs as JSON: power/floor (the suspicion-weight "
            "law), halflife (suspicion EMA, steps), theta_up/theta_down/"
-           "patience/clean_window/levels (the escalation hysteresis).")
+           "patience/clean_window/levels (the escalation hysteresis), "
+           "dp_tau/dp_power/dp_floor/dp_halflife (the data-plane "
+           "spectral tail threshold + its own weight law and EMA).")
     a("--suspicion_halflife", type=float, default=None,
       help="Exponential halflife (in observed steps) of the telemetry "
            "hub's WINDOWED suspicion score (schema v7): the decayed "
@@ -538,11 +547,35 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                 # escalations.
                 kwargs["model_gar"] = args.gar
         if defense_plan is not None and "defense" in trainer_params:
-            kwargs["defense"] = {
-                "power": defense_plan.power,
-                "floor": defense_plan.floor,
-                "halflife": defense_plan.halflife,
-            }
+            dkw = {}
+            if defense_plan.weighted:
+                dkw.update(
+                    power=defense_plan.power,
+                    floor=defense_plan.floor,
+                    halflife=defense_plan.halflife,
+                )
+            else:
+                dkw["weighted"] = False
+            if defense_plan.data:
+                if getattr(topology, "SUPPORTS_DATAPLANE", False):
+                    # Data-plane detectors (DESIGN.md §18): SSMW only —
+                    # its gather holds the full per-rank stack the
+                    # fingerprints need; the host-plane twins live on
+                    # the cluster PS quorums (apps/cluster.py).
+                    dkw["data"] = {
+                        "tau": defense_plan.dp_tau,
+                        "power": defense_plan.dp_power,
+                        "floor": defense_plan.dp_floor,
+                        "halflife": defense_plan.dp_halflife,
+                    }
+                elif step == start_iter:
+                    tools.warning(
+                        f"[{tag}] --defense data: this topology has no "
+                        "in-graph data-plane detectors; the GAR-side "
+                        "defense (if any) still applies"
+                    )
+            if defense_plan.weighted or "data" in dkw:
+                kwargs["defense"] = dkw
         elif defense_plan is not None and step == start_iter:
             tools.warning(
                 f"[{tag}] --defense: this topology has no in-graph "
@@ -765,6 +798,26 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                             np.asarray(m_j["defense_w"], np.float64), 6
                         ).tolist(),
                     )
+                if "dataplane_score" in m_j:
+                    # Data-plane defense observability (schema v9): the
+                    # per-rank spectral outlier scores, detector flags,
+                    # and composed weights of the in-graph detectors —
+                    # the hub digests them into summary.data_defense and
+                    # the garfield_dataplane_outlier_score gauge.
+                    tele_hub.record_event(
+                        "data_defense",
+                        step=int(i + j),
+                        scores=np.round(
+                            np.asarray(m_j["dataplane_score"],
+                                       np.float64), 6
+                        ).tolist(),
+                        flags=[int(x) for x in np.asarray(
+                            m_j["dataplane_flags"]
+                        )],
+                        weights=np.round(
+                            np.asarray(m_j["dataplane_w"], np.float64), 6
+                        ).tolist(),
+                    )
                 if "ps_defense_w" in m_j:
                     # Replica-plane suspicion weights (schema v8): the
                     # MSMW twin's second, independent defense history.
@@ -788,6 +841,28 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                     susp, max(1, declared_f)
                 )
                 act = esc_policy.observe(float(conc))
+                if act:
+                    # Feasibility at this deployment's quorum geometry
+                    # (the cluster PS convention): a ladder level whose
+                    # rule contract fails at n_eff (bulyan needs
+                    # n >= 4f + 3) is refused loudly and reverted —
+                    # rebuilding with it would assert mid-run.
+                    from ..aggregators import gars as gars_reg
+
+                    lvl_gar, _ = esc_policy.current()
+                    n_eff = getattr(args, "subset", None) or num_slots
+                    msg = gars_reg[lvl_gar].check(
+                        np.zeros((n_eff, 4), np.float32),
+                        f=max(1, declared_f),
+                    )
+                    if msg is not None:
+                        tools.warning(
+                            f"[{tag}] defense cannot move to "
+                            f"{esc_policy.level_name!r} at n={n_eff}: "
+                            f"{msg}"
+                        )
+                        esc_policy.level -= act
+                        act = 0
                 if act:
                     tools.info(
                         f"[{tag}] defense "
@@ -874,6 +949,10 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                         None if rep["asr"] is None
                         else round(rep["asr"], 6)
                     ),
+                    asr_baseline=(
+                        None if rep["asr_baseline"] is None
+                        else round(rep["asr_baseline"], 6)
+                    ),
                     per_class={
                         str(k): round(v, 6)
                         for k, v in rep["per_class"].items()
@@ -918,6 +997,10 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
                     None if targeted_rep["asr"] is None
                     else round(targeted_rep["asr"], 6)
                 ),
+                asr_baseline=(
+                    None if targeted_rep["asr_baseline"] is None
+                    else round(targeted_rep["asr_baseline"], 6)
+                ),
                 per_class={
                     str(k): round(v, 6)
                     for k, v in targeted_rep["per_class"].items()
@@ -937,6 +1020,7 @@ def train(args, *, topology, make_trainer_kwargs, num_slots, tag):
         **({"targeted": {
             "confusion": targeted_rep["confusion"],
             "asr": targeted_rep["asr"],
+            "asr_baseline": targeted_rep["asr_baseline"],
             "per_class": targeted_rep["per_class"],
         }} if targeted_rep is not None else {}),
         **{f"step_{k}": v for k, v in timer.summary().items()},

@@ -186,20 +186,49 @@ def apply_trigger(cfg, x):
     return x.at[..., :t].set(v).astype(x.dtype)
 
 
-def _poison_mask(cfg, n, seed):
+def _poison_mask(cfg, n, seed, step=None):
     """Deterministic per-sample poison mask: the first
-    ``round(poison_frac * n)`` positions of a seeded permutation. Derived
-    from ``seed`` alone so every colluder (and every replay) agrees."""
+    ``round(poison_frac * n)`` positions of a seeded permutation.
+
+    With ``step`` the permutation is drawn per STEP from the composite
+    ``(seed, step)`` seed, so a partially-poisoning cohort rotates its
+    poisoned subset across steps like a real poisoner re-sampling its
+    batch — and, because ``step`` also drives the traced twin below,
+    every replay (and every colluder) agrees. ``poison_frac`` 1.0 never
+    draws (the all-ones mask is static), which is what keeps the
+    poison_frac=1 trajectories bitwise unchanged across this seeding.
+    """
     k = int(round(cfg.poison_frac * n))
     if k >= n:
         return np.ones(n, bool)
-    rng = np.random.default_rng(seed)
+    rng = np.random.default_rng(
+        seed if step is None else (int(seed), int(step))
+    )
     mask = np.zeros(n, bool)
     mask[rng.permutation(n)[:k]] = True
     return mask
 
 
-def poison_batch(cfg, x, y, *, seed=0):
+def _poison_mask_traced(cfg, n, seed, step):
+    """Traced twin of ``_poison_mask``: the per-step key is derived by
+    ``fold_in(PRNGKey(seed), step)`` from the TRACED step counter — the
+    scan-carry step of a chunked dispatch (core.make_chunked_step) is
+    the same value the per-step loop folds, so chunked and per-step
+    runs poison bitwise-identical sample sets (pinned in
+    tests/test_chunked.py). Static all-ones short-circuit at
+    ``poison_frac`` 1.0 keeps those programs free of any mask RNG."""
+    import jax
+    import jax.numpy as jnp
+
+    k = int(round(cfg.poison_frac * n))
+    if k >= n:
+        return jnp.ones((n,), bool)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+    perm = jax.random.permutation(key, n)
+    return jnp.zeros((n,), bool).at[perm[:k]].set(True)
+
+
+def poison_batch(cfg, x, y, *, seed=0, step=None):
     """Poison ONE cohort batch: returns ``(x', y')``.
 
     ``labelflip``: samples of class ``source`` (within the poisoned
@@ -208,18 +237,24 @@ def poison_batch(cfg, x, y, *, seed=0):
     ``target`` regardless of its true class. Label arrays may be int
     class ids (multi-class) or the binary float (..., 1) pima targets —
     both are rewritten in their own dtype. Dual-backend (numpy for the
-    host-plane cohort loops, jnp for the traced in-graph slots); the
-    poison-subset mask is host-derived from ``seed`` (static under jit:
-    the per-(slot, batch) seed is known at trace time for the stacked
-    batch streams the topologies feed).
+    host-plane cohort loops, jnp for the traced in-graph slots).
+
+    ``step`` selects the per-step poison subset: the traced path derives
+    it via ``fold_in(seed, step)`` (``step`` may be the traced scan-carry
+    counter — chunked and per-step dispatch poison identical sets), the
+    host path via the composite ``(seed, step)`` rng. ``step=None``
+    keeps the legacy static-per-seed mask.
     """
     xp = _xp_of(y)
     n = int(y.shape[0])
-    sub = _poison_mask(cfg, n, seed)
-    if xp is not np:
-        import jax.numpy as jnp
+    if xp is not np and step is not None:
+        sub = _poison_mask_traced(cfg, n, seed, step)
+    else:
+        sub = _poison_mask(cfg, n, seed, step=step)
+        if xp is not np:
+            import jax.numpy as jnp
 
-        sub = jnp.asarray(sub)
+            sub = jnp.asarray(sub)
     label_shape = (n,) + (1,) * (y.ndim - 1)
     sub_l = sub.reshape(label_shape)
     tgt = xp.asarray(cfg.target, y.dtype) if xp is np else cfg.target

@@ -253,7 +253,14 @@ def targeted_eval(state, eval_fn, eval_set, *, source, target,
         for the backdoor): the trigger is stamped on every NON-target
         test input and ``asr`` is the fraction that flips to ``target``
         — the BadNets success metric, computed with the SAME
-        ``apply_trigger`` the poisoned training batches used.
+        ``apply_trigger`` the poisoned training batches used;
+      - ``asr_baseline``: the clean-model trigger-rate baseline row —
+        ``P(pred == target | true != target)`` over the UNtriggered
+        eval. A model that never saw the trigger still emits the target
+        class at this chance rate when the trigger is stamped, so a raw
+        ASR cell overstates the attack by exactly this floor; DEFBENCH
+        reports ``asr - asr_baseline`` as the attributable lift
+        (schema v9, validated).
 
     Returns a dict with those fields plus ``accuracy`` (global top-1).
     ``eval_set`` must be a ``parallel.EvalSet``.
@@ -270,6 +277,11 @@ def targeted_eval(state, eval_fn, eval_set, *, source, target,
     confusion = (
         float((preds[src_mask] == int(target)).mean())
         if src_mask.any() else None
+    )
+    base_mask = labels != int(target)
+    asr_baseline = (
+        float((preds[base_mask] == int(target)).mean())
+        if base_mask.any() else None
     )
     asr = None
     if trigger_cfg is not None:
@@ -291,4 +303,5 @@ def targeted_eval(state, eval_fn, eval_set, *, source, target,
         "target": int(target),
         "confusion": confusion,
         "asr": asr,
+        "asr_baseline": asr_baseline,
     }

@@ -46,6 +46,12 @@ from . import core, fold, mesh as mesh_lib
 
 __all__ = ["make_trainer"]
 
+# The data-plane defense (aggregators/dataplane.py, DESIGN.md §18) is
+# deployed in-graph on THIS topology only: the SSMW gather holds the full
+# per-rank stack every step, which is the quorum the fingerprints need.
+# apps/common.py keys on this flag instead of growing a per-topology arg.
+SUPPORTS_DATAPLANE = True
+
 
 def _resolve_gar(gar):
     if isinstance(gar, str):
@@ -241,6 +247,18 @@ def make_trainer(
     on level changes, like the crash-schedule re-jit), so one policy
     module serves both deployment scales.
 
+    A ``defense`` dict may additionally (or instead — ``weighted:
+    False``) carry ``data`` (``tau``/``power``/``floor``/``halflife``):
+    the DATA-plane detectors (aggregators/dataplane.py, DESIGN.md §18)
+    — per-class classifier-head gradient fingerprints, spectral
+    filtering + 2-means cohort clustering over the gathered stack, a
+    carried dp exclusion EMA (``dp_obs``/``dp_exc`` in
+    ``TrainState.defense_state``), composed by CENTER-PULL onto the
+    trusted mean (row scaling hands a data poisoner krum centrality —
+    the negative result §18 records). Per-step scores/flags/weights
+    surface as ``dataplane_*`` metrics (schema-v9 ``data_defense``
+    events in the app loop).
+
     ``step_fn(state, x, y) -> (state, metrics)`` expects ``x``/``y`` with a
     leading ``num_workers`` axis, sharded over ``axis``; it is jit'd with
     replicated state output, so calling it in a loop keeps everything
@@ -359,24 +377,62 @@ def make_trainer(
         fold_plan = fold.plan_for(gar, attack, byz_mask, attack_params)
     byz_mask = jnp.asarray(byz_mask, dtype=bool)
     # Closed-loop defense (see docstring): normalized EMA/weighting knobs.
+    # ``weighted`` (default True) enables the GAR-suspicion weighting;
+    # ``data`` enables the DATA-plane detectors (aggregators/dataplane.py,
+    # DESIGN.md §18) — per-class head-gradient fingerprints, spectral
+    # filtering + 2-means cohort flags, folded into their OWN carried
+    # exclusion EMA and composed through the same row-weight algebra.
     d_power = d_floor = d_decay = None
+    d_weighted = False
+    dp_tau = dp_power = dp_floor = dp_decay = None
     if defense is not None:
+        from ..aggregators import dataplane as dataplane_lib
         from ..aggregators import defense as defense_lib
 
         dd = dict(defense)
-        d_power = float(dd.pop("power", 2.0))
-        d_floor = float(dd.pop("floor", 0.1))
-        halflife = float(dd.pop("halflife", 16.0))
+        d_weighted = bool(dd.pop("weighted", True))
+        data_d = dd.pop("data", None)
+        if d_weighted:
+            d_power = float(dd.pop("power", 2.0))
+            d_floor = float(dd.pop("floor", 0.1))
+            halflife = float(dd.pop("halflife", 16.0))
+            if halflife <= 0.0:
+                raise ValueError(
+                    f"defense halflife must be > 0, got {halflife}"
+                )
+            # Per-step multiplicative decay of the carried exclusion EMA:
+            # the in-graph twin of MetricsHub(suspicion_halflife=).
+            d_decay = float(0.5 ** (1.0 / halflife))
+            defense_lib.suspicion_weights(
+                [0.0], power=d_power, floor=d_floor
+            )  # validate the knobs once, loudly
         if dd:
             raise ValueError(f"unknown defense keys {sorted(dd)}")
-        if halflife <= 0.0:
-            raise ValueError(f"defense halflife must be > 0, got {halflife}")
-        # Per-step multiplicative decay of the carried exclusion EMA: the
-        # in-graph twin of MetricsHub(suspicion_halflife=).
-        d_decay = float(0.5 ** (1.0 / halflife))
-        defense_lib.suspicion_weights(
-            [0.0], power=d_power, floor=d_floor
-        )  # validate the knobs once, loudly
+        if data_d is not None:
+            dpd = dict(data_d)
+            dp_tau = float(dpd.pop("tau", dataplane_lib.DEFAULT_TAU))
+            dp_power = float(dpd.pop("power", 4.0))
+            dp_floor = float(dpd.pop("floor", 0.0))
+            dp_halflife = float(dpd.pop("halflife", 8.0))
+            if dpd:
+                raise ValueError(
+                    f"unknown defense.data keys {sorted(dpd)}"
+                )
+            if dp_tau <= 0.0:
+                raise ValueError(f"dp tau must be > 0, got {dp_tau}")
+            if dp_halflife <= 0.0:
+                raise ValueError(
+                    f"dp halflife must be > 0, got {dp_halflife}"
+                )
+            dp_decay = float(0.5 ** (1.0 / dp_halflife))
+            defense_lib.suspicion_weights(
+                [0.0], power=dp_power, floor=dp_floor
+            )
+        if not d_weighted and dp_decay is None:
+            raise ValueError(
+                "defense enabled with neither suspicion weighting nor "
+                "data-plane detectors; pass weighted and/or data"
+            )
 
     # Bounded-staleness emulation (see docstring). Normalized here so the
     # trivially-synchronous configs drop the machinery at BUILD time: the
@@ -455,12 +511,22 @@ def make_trainer(
             attack_state = adaptive_lib.init_state(adaptive_cfg)
         defense_state = None
         if defense is not None:
-            # Carried exclusion EMA: nothing observed yet, suspicion 0,
-            # weights exactly 1.0 — the clean-history identity.
-            defense_state = {
-                "obs": jnp.zeros((num_workers,), jnp.float32),
-                "exc": jnp.zeros((num_workers,), jnp.float32),
-            }
+            # Carried exclusion EMAs: nothing observed yet, suspicion 0,
+            # weights exactly 1.0 — the clean-history identity. The
+            # data-plane detectors carry their OWN twins (independent
+            # halflife; a GAR exclusion and a fingerprint flag are
+            # different evidence).
+            defense_state = {}
+            if d_weighted:
+                defense_state.update({
+                    "obs": jnp.zeros((num_workers,), jnp.float32),
+                    "exc": jnp.zeros((num_workers,), jnp.float32),
+                })
+            if dp_decay is not None:
+                defense_state.update({
+                    "dp_obs": jnp.zeros((num_workers,), jnp.float32),
+                    "dp_exc": jnp.zeros((num_workers,), jnp.float32),
+                })
         state = core.TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -494,7 +560,8 @@ def make_trainer(
             xs_p, ys_p = [], []
             for k in range(per_shard):
                 xk, yk = targeted_lib.poison_batch(
-                    targeted_cfg, x_local[k], y_local[k], seed=k
+                    targeted_cfg, x_local[k], y_local[k], seed=k,
+                    step=state.step,
                 )
                 xs_p.append(xk)
                 ys_p.append(yk)
@@ -606,20 +673,53 @@ def make_trainer(
         # weight algebra as the staleness discount. Exactly 1.0 on a
         # clean history (the weighted identity contract).
         def_w = None
-        if defense is not None:
+        if defense is not None and d_weighted:
             susp = state.defense_state["exc"] / jnp.maximum(
                 state.defense_state["obs"], 1e-6
             )
             def_w = defense_lib.suspicion_weights(
                 susp, power=d_power, floor=d_floor
             )
+
+        # Data-plane defense (aggregators/dataplane.py, DESIGN.md §18):
+        # fingerprint the classifier-head block of the SAME stacked tree
+        # the rule consumes (post-momentum — the rows a data poisoner
+        # actually submitted), run the spectral + 2-means detectors, map
+        # the carried dp exclusion EMA through the suspicion-weight law,
+        # and compose by CENTER-PULL: suspect rows collapse onto the
+        # dp-weight-weighted TRUSTED mean instead of being scaled toward
+        # the origin (toward-zero dampening hands a data poisoner krum
+        # centrality — the inlier inversion measured in DEFBENCH; see
+        # dataplane.center_pull_rows). The transform is per-leaf
+        # elementwise like the momentum update, so every downstream path
+        # (tree, fold, flat) is unchanged. Traced out entirely when off
+        # (the TapBundle convention).
+        dp_w = dp_scores = dp_flags = None
+        if dp_decay is not None:
+            head_k, head_b = dataplane_lib.head_leaves(grads)
+            if head_k is None:
+                raise ValueError(
+                    "data-plane defense needs a classifier head (no "
+                    "2-D parameter leaf in this model)"
+                )
+            dp_scores, flags_b = dataplane_lib.detect(
+                head_k, head_b, f=max(1, f), tau=dp_tau
+            )
+            dp_flags = flags_b.astype(jnp.float32)
+            dp_susp = state.defense_state["dp_exc"] / jnp.maximum(
+                state.defense_state["dp_obs"], 1e-6
+            )
+            dp_w = defense_lib.suspicion_weights(
+                dp_susp, power=dp_power, floor=dp_floor
+            )
+            grads = dataplane_lib.center_pull_tree(grads, dp_w)
         row_w = stale_w
         if def_w is not None:
             row_w = def_w if row_w is None else row_w * def_w
 
         # Selection feedback the two carries consume: the rule's (n,)
         # selection weights (sel_w) and the observation mask (obs_vec).
-        need_sel = adaptive_cfg is not None or defense is not None
+        need_sel = adaptive_cfg is not None or d_weighted
         sel_w = quorum_idx = None
 
         agg_kwargs = dict(
@@ -813,15 +913,32 @@ def make_trainer(
 
         new_defense_state = state.defense_state
         if defense is not None:
-            # The hub's exclusion law (observed minus admitted), carried
-            # as an exponentially-decayed EMA — the in-graph twin of
-            # MetricsHub(suspicion_halflife=).
-            ind = (sel_w > 0).astype(jnp.float32) * obs_vec
-            dec = jnp.float32(d_decay)
-            new_defense_state = {
-                "obs": state.defense_state["obs"] * dec + obs_vec,
-                "exc": state.defense_state["exc"] * dec + (obs_vec - ind),
-            }
+            new_defense_state = dict(state.defense_state)
+            if d_weighted:
+                # The hub's exclusion law (observed minus admitted),
+                # carried as an exponentially-decayed EMA — the in-graph
+                # twin of MetricsHub(suspicion_halflife=).
+                ind = (sel_w > 0).astype(jnp.float32) * obs_vec
+                dec = jnp.float32(d_decay)
+                new_defense_state["obs"] = (
+                    state.defense_state["obs"] * dec + obs_vec
+                )
+                new_defense_state["exc"] = (
+                    state.defense_state["exc"] * dec + (obs_vec - ind)
+                )
+            if dp_decay is not None:
+                # Data-plane twins: the detectors observe the FULL
+                # gathered stack every step (the subset emulation applies
+                # at selection, after the gather), so every rank is
+                # observed and a flag is an exclusion.
+                dpdec = jnp.float32(dp_decay)
+                ones = jnp.ones((num_workers,), jnp.float32)
+                new_defense_state["dp_obs"] = (
+                    state.defense_state["dp_obs"] * dpdec + ones
+                )
+                new_defense_state["dp_exc"] = (
+                    state.defense_state["dp_exc"] * dpdec + dp_flags
+                )
 
         new_gar_state = state.gar_state
         if gar.stateful_center:
@@ -850,11 +967,18 @@ def make_trainer(
             # played and whether the rule caught it this round.
             metrics["attack_mag"] = jnp.asarray(atk_mag, jnp.float32)
             metrics["attack_detected"] = detected.astype(jnp.float32)
-        if defense is not None:
+        if defense is not None and d_weighted:
             # The suspicion weights actually composed this step (the app
             # loop surfaces them as ``defense_weights`` events — the
             # summary's suspicion-weight digest at the on-mesh scale).
             metrics["defense_w"] = def_w
+        if dp_decay is not None:
+            # Data-plane observability (schema v9 ``data_defense``
+            # events): the per-rank spectral outlier scores, this
+            # round's detector flags, and the weights composed.
+            metrics["dataplane_score"] = dp_scores.astype(jnp.float32)
+            metrics["dataplane_flags"] = dp_flags
+            metrics["dataplane_w"] = dp_w
         if telemetry:
             # In-graph audit tap (telemetry/taps.py): recompute the
             # poisoned flat stack with the SAME keys the aggregation used

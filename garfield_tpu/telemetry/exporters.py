@@ -88,8 +88,19 @@ SCHEMA = "garfield-telemetry"
 # may carry a ``plane`` tag (gradient/model/gossip — the per-plane
 # ladder deployment), and ``defense_bench`` rows may carry ``plane``/
 # ``confusion``/``asr``/``clean_confusion`` (the plane column and the
-# targeted rows' success metric).
-SCHEMA_VERSION = 8
+# targeted rows' success metric). v9 (round 16, the data-plane defense —
+# DESIGN.md §18): the ``data_defense`` EVENT (one round of the
+# fingerprint detectors: per-rank spectral outlier ``scores``, the
+# tau-sigma/2-means ``flags``, the composed ``weights``, optional
+# ``ranks``/``plane`` attribution — validated below), ``summary`` gained
+# the optional ``data_defense`` digest (rounds/flagged/max_score/min_w)
+# and the ``garfield_dataplane_outlier_score`` Prometheus gauge,
+# ``targeted_eval`` events and ``defense_bench`` rows may carry
+# ``asr_baseline`` (the clean-model trigger-rate floor — ASR cells
+# report attributable lift, not raw rate), and ``defense_bench``
+# ``defense`` strings may name the composed modes (``data``/
+# ``escalate+data``).
+SCHEMA_VERSION = 9
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
          "transfer_bench", "exchange_bench", "hier_bench", "span",
@@ -242,7 +253,9 @@ def validate_record(rec):
                     _fail(
                         f"targeted_eval.{key} must be an int, got {val!r}"
                     )
-            for key in ("confusion", "asr", "accuracy"):
+            for key in ("confusion", "asr", "accuracy",
+                        # v9: the clean-model trigger-rate floor.
+                        "asr_baseline"):
                 val = rec.get(key)
                 if val is not None and not _is_num(val):
                     _fail(
@@ -266,6 +279,32 @@ def validate_record(rec):
             if ranks is not None:
                 _check_float_list(
                     "defense_weights", "ranks", ranks, len(ws)
+                )
+        elif rec.get("event") == "data_defense":
+            # v9: one round of the data-plane detectors (aggregators/
+            # dataplane.py): per-rank spectral outlier scores, the
+            # tau-sigma/2-means flags, the weights composed into the
+            # quorum, optional rank attribution + plane tag.
+            sc = rec.get("scores")
+            _check_float_list("data_defense", "scores", sc)
+            for key in ("flags", "weights", "ranks"):
+                val = rec.get(key)
+                if val is not None:
+                    _check_float_list("data_defense", key, val, len(sc))
+            plane = rec.get("plane")
+            if plane is not None and not isinstance(plane, str):
+                _fail(
+                    f"data_defense.plane must be a string or null, "
+                    f"got {plane!r}"
+                )
+            step = rec.get("step")
+            if step is not None and (
+                not isinstance(step, int) or isinstance(step, bool)
+                or step < 0
+            ):
+                _fail(
+                    f"data_defense.step must be a non-negative int or "
+                    f"null, got {step!r}"
                 )
         elif rec.get("event") == "defense_escalate":
             # v7: one rule-ladder transition of the closed-loop defense.
@@ -385,6 +424,28 @@ def validate_record(rec):
                     _fail(
                         f"summary.defense.{key} must be a number or "
                         f"null, got {val!r}"
+                    )
+        dpd = rec.get("data_defense")
+        if dpd is not None:
+            # v9: the data-plane defense digest (hub.data_defense_stats).
+            if not isinstance(dpd, dict):
+                _fail(
+                    f"summary.data_defense must be an object, got {dpd!r}"
+                )
+            for key in ("rounds", "flagged"):
+                val = dpd.get(key)
+                if not isinstance(val, int) or isinstance(val, bool) \
+                        or val < 0:
+                    _fail(
+                        f"summary.data_defense.{key} must be a "
+                        f"non-negative int, got {val!r}"
+                    )
+            for key in ("max_score", "min_w"):
+                val = dpd.get(key)
+                if val is not None and not _is_num(val):
+                    _fail(
+                        f"summary.data_defense.{key} must be a number "
+                        f"or null, got {val!r}"
                     )
         tgt = rec.get("targeted")
         if tgt is not None:
@@ -532,8 +593,10 @@ def validate_record(rec):
             )
         for key in ("final_accuracy", "final_loss", "attack_magnitude",
                     "wall_s",
-                    # v8: the targeted rows' success metrics.
-                    "confusion", "asr", "clean_confusion"):
+                    # v8: the targeted rows' success metrics; v9 adds
+                    # the clean-model trigger-rate floor.
+                    "confusion", "asr", "clean_confusion",
+                    "asr_baseline"):
             val = rec.get(key)
             if val is not None and not _is_num(val):
                 _fail(
@@ -809,6 +872,23 @@ def prometheus_text(hub):
             metric("garfield_defense_min_weight", "gauge",
                    "Smallest suspicion weight applied so far.",
                    [({}, float(dfs["min_w"]))])
+    dpd = hub.data_defense_stats()
+    if dpd is not None:
+        # v9: the data-plane defense (DESIGN.md §18) — per-rank spectral
+        # outlier scores from the last audited quorum plus the detector
+        # counters.
+        metric("garfield_dataplane_outlier_score", "gauge",
+               "Spectral outlier score of each rank's gradient "
+               "fingerprint at the last data-defense round (v9).",
+               [({"rank": str(r)}, float(s))
+                for r, s in sorted(dpd["scores"].items())])
+        metric("garfield_dataplane_flagged_total", "counter",
+               "Rank-rounds flagged by the data-plane detectors.",
+               [({}, float(dpd["flagged"]))])
+        if dpd["min_w"] is not None:
+            metric("garfield_dataplane_min_weight", "gauge",
+                   "Smallest data-plane suspicion weight applied so far.",
+                   [({}, float(dpd["min_w"]))])
     susp = hub.suspicion()
     if susp is not None:
         metric("garfield_rank_suspicion", "gauge",

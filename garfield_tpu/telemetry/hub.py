@@ -80,6 +80,14 @@ class MetricsHub:
             "rule": None,
         }
         self._attack_adapt = {"events": 0, "last_mag": None}
+        # Data-plane defense accounting (schema v9, DESIGN.md §18):
+        # folded from ``data_defense`` events — per-rank spectral outlier
+        # scores (the garfield_dataplane_outlier_score gauge), flag and
+        # weight extremes for the summary digest.
+        self._dataplane = {
+            "rounds": 0, "flagged": 0, "max_score": None, "min_w": None,
+            "scores": {},
+        }
         # Targeted-attack eval accounting (schema v8, DESIGN.md §17):
         # folded from ``targeted_eval`` events — the per-class digest the
         # divergence-blind suspicion plane cannot produce.
@@ -290,6 +298,34 @@ class MetricsHub:
                     d["level"] = int(fields["level"])
                 if fields.get("rule") is not None:
                     d["rule"] = str(fields["rule"])
+            elif kind == "data_defense":
+                # v9: one round of the data-plane detectors (aggregators/
+                # dataplane.py) — digest extremes + the last per-rank
+                # scores for the Prometheus gauge; raw events stream to
+                # the sink like everything else.
+                d = self._dataplane
+                d["rounds"] += 1
+                sc = list(fields.get("scores") or ())
+                fl = list(fields.get("flags") or ())
+                ws = list(fields.get("weights") or ())
+                d["flagged"] += int(sum(1 for x in fl if x))
+                if sc:
+                    m = float(max(sc))
+                    d["max_score"] = (
+                        m if d["max_score"] is None
+                        else max(d["max_score"], m)
+                    )
+                    ranks = fields.get("ranks")
+                    if ranks is None:
+                        ranks = range(len(sc))
+                    for r, s in zip(ranks, sc):
+                        d["scores"][int(r)] = float(s)
+                if ws:
+                    wmin = float(min(ws))
+                    d["min_w"] = (
+                        wmin if d["min_w"] is None
+                        else min(d["min_w"], wmin)
+                    )
             elif kind in ("attack_adapt", "ps_attack_adapt"):
                 # v8: the model-plane twin folds into the same digest —
                 # one adaptive adversary per run is the deployed shape,
@@ -413,6 +449,28 @@ class MetricsHub:
                 "deescalations": int(d["deescalations"]),
                 "level": d["level"],
                 "rule": d["rule"],
+            }
+
+    def data_defense_stats(self):
+        """Data-plane defense digest (schema v9), or None when no
+        ``data_defense`` event was folded. ``scores`` is the last
+        per-rank outlier-score map (the Prometheus gauge's samples);
+        the summary digest drops it (rounds/flagged/max_score/min_w)."""
+        with self._lock:
+            d = self._dataplane
+            if not d["rounds"]:
+                return None
+            return {
+                "rounds": int(d["rounds"]),
+                "flagged": int(d["flagged"]),
+                "max_score": (
+                    None if d["max_score"] is None
+                    else round(d["max_score"], 6)
+                ),
+                "min_w": (
+                    None if d["min_w"] is None else round(d["min_w"], 6)
+                ),
+                "scores": dict(d["scores"]),
             }
 
     def targeted_stats(self):
@@ -595,6 +653,13 @@ class MetricsHub:
         defense = self.defense_stats()
         adapt = self.attack_adapt_stats()
         targeted = self.targeted_stats()
+        data_defense = self.data_defense_stats()
+        if data_defense is not None:
+            # The per-rank score map serves the Prometheus gauge only;
+            # the summary digest keeps the bounded extremes.
+            data_defense = {
+                k: v for k, v in data_defense.items() if k != "scores"
+            }
         stale = self.staleness_stats()
         autos = self.autoscale_stats()
         wire_planes = self.wire_plane_counters()
@@ -633,6 +698,9 @@ class MetricsHub:
                 # schema v8: targeted-eval digest (None on untargeted
                 # runs — v7 consumers see nothing new).
                 targeted=targeted,
+                # schema v9: data-plane defense digest (None on runs
+                # without the data detectors).
+                data_defense=data_defense,
                 observed=(
                     None if self._observed is None
                     else np.round(self._observed, 3).tolist()

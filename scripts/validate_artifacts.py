@@ -14,7 +14,11 @@ event/summary additions (attack_adapt, defense_weights,
 defense_escalate, attack_fallback, suspicion_decayed) — and the v8
 threat-model-matrix additions (ps_attack_adapt, targeted_eval,
 plane-tagged defense events, the DEFBENCH_r02 grid rows with
-plane/confusion/asr columns).
+plane/confusion/asr columns) — and the v9 data-plane-defense additions
+(the data_defense event with matched-length scores/flags/weights/ranks
+lists, summary.data_defense, the asr_baseline field on targeted_eval
+events and DEFBENCH_r03's defense_bench rows with the composed
+data/escalate+data defense strings).
 
   python scripts/validate_artifacts.py            # repo root auto-found
   python scripts/validate_artifacts.py /some/repo

@@ -79,7 +79,8 @@ _RUN_LAST = {
     "test_cluster.py": 3,
     "test_async_cluster.py": 4,
     "test_defense_cluster.py": 5,
-    "test_apps.py": 6,
+    "test_dataplane_cluster.py": 6,
+    "test_apps.py": 7,
 }
 
 # Tier-1 wall-clock budget of the verify command (ROADMAP.md): the

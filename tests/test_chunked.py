@@ -166,6 +166,24 @@ def test_rolled_scan_flavor_bitwise_equal():
     )
 
 
+def test_targeted_partial_poison_chunked_bitwise():
+    """Poison-mask seeding under chunking (ISSUE 12 satellite): with
+    ``poison_frac < 1`` the per-step poison subset is derived via
+    ``fold_in(seed, step)`` from the SCAN CARRY's step counter, so a
+    chunked run poisons bitwise-identical sample sets to the per-step
+    loop — the seeding can never drift between the two dispatch shapes.
+    (At poison_frac 1.0 the mask is statically all-ones and the program
+    is unchanged — covered by the PR-11 pin in tests/test_dataplane.py.)
+    """
+    module, loss, opt = _setup()
+    init_fn, step_fn, _ = aggregathor.make_trainer(
+        module, loss, opt, "krum", num_workers=8, f=2,
+        attack="backdoor",
+        attack_params={"source": 0, "target": 1, "poison_frac": 0.5},
+    )
+    _compare(init_fn, step_fn, ks=(1, 4, 8))
+
+
 def test_make_chunked_step_validates():
     module, loss, opt = _setup()
     init_fn, step_fn, _ = aggregathor.make_trainer(

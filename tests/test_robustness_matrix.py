@@ -245,6 +245,130 @@ def test_adaptive_model_plane_stays_bounded(rule):
     assert hi - lo < 4.0, f"model/{rule}: bracket never converged"
 
 
+# --- data-plane defense rows (DESIGN.md §18) --------------------------------
+#
+# The stack-level closed loop for the TARGETED family: per-rank head
+# gradients with a poisoning cohort's signature (backdoor: coherent
+# off-direction rows + shifted bias, the all-relabeled batch; labelflip:
+# target-class rows flipped against the honest direction), run through
+# the fingerprint detectors + EMA weighting of aggregators/dataplane.py
+# and composed into the rule — sync and async (staleness-discount
+# composition), data-only and escalate+data (GAR-suspicion weights
+# composed on top), plus one hier-krum composition row.
+
+DP_N, DP_F, DP_FEAT = 16, 3, 24
+
+
+def _targeted_head_rows(attack, rng):
+    """(rows, honest_mean): flat [bias | head-kernel] rows with the
+    targeted cohort's data-plane signature in the last DP_F ranks."""
+    base = rng.normal(size=(DP_FEAT,)).astype(np.float32)
+    H = base[None] + 0.25 * rng.standard_normal(
+        (DP_N, DP_FEAT)
+    ).astype(np.float32)
+    b = 0.3 * rng.standard_normal((DP_N, 1)).astype(np.float32)
+    for i in range(DP_N - DP_F, DP_N):
+        if attack == "backdoor":
+            # Trigger cohort: near-identical poisoned batches, loss mass
+            # on the target logit — coherent rows + strong bias shift.
+            H[i] = -0.7 * base + 0.05 * rng.standard_normal(
+                DP_FEAT
+            ).astype(np.float32)
+            b[i] = -2.5
+        else:
+            # Labelflip: the source samples' head rows push the target
+            # logit the wrong way — flipped against the honest direction.
+            H[i] = -base + 0.15 * rng.standard_normal(
+                DP_FEAT
+            ).astype(np.float32)
+            b[i] = -1.5
+    rows = np.concatenate([b, H], axis=1).astype(np.float32)
+    honest_mean = rows[: DP_N - DP_F].mean(axis=0)
+    return rows, honest_mean
+
+
+@pytest.mark.parametrize("mode", ["sync", "async"])
+@pytest.mark.parametrize("defense", ["data", "escalate+data"])
+@pytest.mark.parametrize("attack", ["backdoor", "labelflip"])
+def test_dataplane_defense_matrix(attack, defense, mode):
+    """backdoor/labelflip x data/escalate+data x sync/async: the
+    detectors pin the cohort at the weight floor within the EMA window,
+    honest ranks (a staleness-discounted straggler included) keep ~1.0,
+    and the weighted krum aggregate lands near the honest mean."""
+    from garfield_tpu.aggregators import dataplane as dp, defense as dlib
+    from garfield_tpu.utils import rounds
+
+    rng = np.random.default_rng(
+        zlib.crc32(f"dp-{attack}-{defense}-{mode}".encode())
+    )
+    spec = dp.HeadSpec(
+        kernel=(1, 1 + DP_FEAT), bias=(0, 1), feat=DP_FEAT, classes=1
+    )
+    pdef = dp.DataPlaneDefense(
+        DP_N, spec, f=DP_F, halflife=4.0, floor=0.1
+    )
+    gar_susp = np.zeros(DP_N)
+    agg = hm = None
+    for t in range(12):
+        rows, hm = _targeted_head_rows(attack, rng)
+        pdef.observe(np.arange(DP_N), rows)
+        # Data-plane composition is CENTER-PULL (suspect rows collapse
+        # onto the trusted-mean center — toward-zero scaling hands the
+        # cohort krum centrality, the recorded negative result)...
+        rows_def = dp.center_pull_rows(rows, pdef.weights_full())
+        # ...while the GAR-side suspicion and staleness discounts keep
+        # their row-scale slot, composed on top.
+        w = np.ones(DP_N, np.float32)
+        if defense == "escalate+data":
+            w = w * np.asarray(dlib.suspicion_weights(gar_susp))
+        if mode == "async":
+            taus = np.zeros(DP_N, np.int64)
+            taus[1] = 2  # one stale HONEST rank: discounted, not flagged
+            w = w * rounds.staleness_weights(
+                taus, decay=0.5, max_staleness=4
+            )
+        agg = np.asarray(gars["krum"].unchecked(
+            jnp.asarray(rows_def * w[:, None]), f=DP_F
+        ))
+    w = pdef.weights_full()
+    assert (w[DP_N - DP_F:] <= 0.11).all(), (attack, defense, mode, w)
+    assert (w[: DP_N - DP_F] >= 0.9).all(), (attack, defense, mode, w)
+    # The stale honest rank was discounted by staleness but never
+    # FLAGGED by the data plane (its fingerprint is in-crowd).
+    assert pdef.suspicion()[1] < 0.1
+    err = float(np.linalg.norm(agg - hm))
+    tol = 0.5 * np.sqrt(DP_FEAT + 1)
+    assert err <= tol, f"{attack}/{defense}/{mode}: err {err:.3f}"
+
+
+def test_dataplane_composes_with_hier_krum():
+    """Composition row: the center-pulled stack feeds the hierarchical
+    bucketed rule exactly like the flat rules — the hier-krum aggregate
+    over the defended stack must land on the honest mean (the pulled
+    cohort rows are selectable but informationless)."""
+    from garfield_tpu.aggregators import dataplane as dp
+
+    rng = np.random.default_rng(zlib.crc32(b"dp-hier"))
+    spec = dp.HeadSpec(
+        kernel=(1, 1 + DP_FEAT), bias=(0, 1), feat=DP_FEAT, classes=1
+    )
+    pdef = dp.DataPlaneDefense(
+        DP_N, spec, f=DP_F, halflife=4.0, floor=0.1
+    )
+    agg = hm = None
+    for _ in range(12):
+        rows, hm = _targeted_head_rows("backdoor", rng)
+        pdef.observe(np.arange(DP_N), rows)
+        rows_def = dp.center_pull_rows(rows, pdef.weights_full())
+        agg = np.asarray(gars["hier-krum"].unchecked(
+            jnp.asarray(rows_def), f=DP_F
+        ))
+    err = float(np.linalg.norm(agg - hm))
+    assert err <= 0.5 * np.sqrt(DP_FEAT + 1), err
+    w = pdef.weights_full()
+    assert (w[DP_N - DP_F:] <= 0.11).all()
+
+
 # --- targeted rows (DESIGN.md §17) ------------------------------------------
 
 
