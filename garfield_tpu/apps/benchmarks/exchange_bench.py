@@ -68,8 +68,10 @@ _STOP_ROUND = 2 ** 40
 
 
 def peak_rss_bytes():
-    """High-water RSS of this process (bytes) — per-row accounting like
-    HIERBENCH (gar_bench.peak_rss_bytes)."""
+    """High-water RSS of this process (bytes) — same accounting as
+    apps/common.peak_rss_bytes, duplicated (not imported) because this
+    module and its child processes are deliberately jax-free and the
+    apps.common import chain pulls jax/models/data."""
     import resource
 
     return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024

@@ -17,7 +17,6 @@ committed value is the min over ``--trials`` independent measurements
 
 import argparse
 import json
-import resource
 import sys
 import time
 
@@ -28,6 +27,7 @@ import numpy as np
 from ... import aggregators
 from ...aggregators import hierarchy
 from ...utils import profiling
+from ..common import peak_rss_bytes
 
 # Practical bound for brute's exhaustive enumeration, like the reference's
 # sweep bound (gar_bench.py:51 keeps n small for brute).
@@ -60,13 +60,6 @@ def max_f(rule, n):
     }
     base = rule.split("native-")[-1]
     return max(bounds.get(base, 0), 0)
-
-
-def peak_rss_bytes():
-    """Process high-water RSS in bytes (``getrusage``; monotone — sweep
-    rows are recorded in ascending-n order so O(buckets)-memory claims are
-    visible as a flat profile, not laundered by earlier peaks)."""
-    return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
 
 
 def bench_one(gar, n, f, d, reps, key, trials=1):

@@ -436,6 +436,15 @@ def _telemetry_close(hub, exp):
         tele_hooks.uninstall()
 
 
+# Public aliases (DESIGN.md §19): the federated shard/fleet roles
+# (apps/benchmarks/fed_bench.py) are cluster-style OS processes and
+# reuse the per-role telemetry plane and wire accounting verbatim —
+# aliased rather than duplicated so the stream/summary format cannot
+# drift between the cluster and federated deployments.
+telemetry_open = _telemetry_open
+telemetry_close = _telemetry_close
+
+
 def _robust_stats(rows, f):
     """Coordinate-wise trimmed mean of worker-supplied BatchNorm-statistic
     rows under the deployment's f budget (ADVICE r4 medium).
@@ -475,7 +484,7 @@ def _eager_h2d():
     )
 
 
-class _WireStats:
+class WireStats:
     """Per-role wire-plane accounting for the telemetry plane
     (docs/TELEMETRY.md): bytes and codec seconds, both directions,
     broken down PER PLANE (schema v6 — the ``planes`` sub-object of the
@@ -1192,7 +1201,7 @@ def _run_ps(args, q, worker_ranks, test_batches, optimizer, eval_fn,
     # Wire plane (DESIGN.md §11): every data frame goes through the typed
     # codec — encode once per step here, decode eagerly per arriving frame
     # in the exchange waiter threads (``_frame_transform``).
-    wire_stats = _WireStats("cluster-ps")
+    wire_stats = WireStats("cluster-ps")
     split = (flat.size, bn_elems)
     grad_tf = _frame_transform(split, wire_stats, plane=PLANE_GRAD)
     # Bounded-staleness async mode (--async; DESIGN.md §14): ONE
@@ -1925,7 +1934,7 @@ def _run_ps_multi(args, pindex, ps_ranks, q, worker_ranks, test_batches,
     flat = np.asarray(flat0, np.float32)
     flat_dev = jnp.asarray(flat)  # --num_iter 0: eval the init model
     good_ranks = list(worker_ranks)
-    wire_stats = _WireStats(who)
+    wire_stats = WireStats(who)
     split = (flat.size, bn_elems)
     model_tf = _frame_transform(split, wire_stats, plane=PLANE_MODEL)
     grad_tf = _frame_transform(split, wire_stats, plane=PLANE_GRAD)
@@ -2721,7 +2730,7 @@ def _run_learn(args):
     # Wire plane (DESIGN.md §11): LEARN's gradient plane ships bare
     # gradients, the gossip plane [params || stats] — both through the
     # typed codec, decoded eagerly by the pre-registered waiters.
-    wire_stats = _WireStats(who)
+    wire_stats = WireStats(who)
     grad_split = (flat.size, 0)
     gossip_split = (flat.size, bn_elems)
     grad_tf = _frame_transform(grad_split, wire_stats, plane=PLANE_GRAD)
@@ -3426,7 +3435,7 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
     targeted_cfg = None
     if atk_kind == "targeted":
         targeted_cfg = _targeted_config(args, who)
-    wire_stats = _WireStats(who)
+    wire_stats = WireStats(who)
     split = (flat_np.size, bn_elems)
     # pass_empty: the PS's stop sentinel is an empty frame, not a codec
     # frame — it must reach the loop's sentinel check undecoded.

@@ -25,7 +25,19 @@ import numpy as np
 from .. import data as data_lib, models as models_lib, parallel
 from ..utils import checkpoint as ckpt_lib, profiling, selectors, tools
 
-__all__ = ["base_parser", "build_ingredients", "chunk_length", "train"]
+__all__ = ["base_parser", "build_ingredients", "chunk_length",
+           "peak_rss_bytes", "train"]
+
+
+def peak_rss_bytes():
+    """Process high-water RSS in bytes (``getrusage``) — the shared
+    flat-memory accounting every committed bench row carries
+    (HIERBENCH/EXCHBENCH/FEDBENCH; one definition so the artifacts stay
+    comparable). Monotone: record rows in ascending-size order so an
+    O(1)-memory claim reads as a flat profile."""
+    import resource
+
+    return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
 
 
 def base_parser(description, *, default_model="convnet", default_loss="nll"):

@@ -1,0 +1,306 @@
+"""The federated round engine: sharded PS plane over the hierarchy.
+
+One round = SAMPLE (sampler.py) -> INGEST (every shard streams its d/S
+column span of each cohort gradient through its own hierarchical
+reducer, aggregators/hierarchy.StreamingAggregator) -> FOLD (per-shard
+hier-GAR at the cohort's priced f budget) -> BROADCAST (per-shard model
+spans re-published; the unsharded vector exists only where a consumer
+reassembles it). ``ShardServer`` is the per-shard half — a standalone
+object one OS process runs for exactly one shard, with its own wire
+plane (frames stamped with the shard id, cross-shard arrivals are
+attributable codec rejects) — and ``FedRoundEngine`` composes S of them
+in one process: the simulation driver, the bitwise-equality anchor, and
+the single-host deployment shape.
+
+Bitwise anchor: at S=1 with full participation and no stragglers the
+engine IS the existing unsharded single-PS streaming path — same
+``StreamingAggregator`` programs over the same arrival order, same
+``model -= lr * agg`` update — so its trajectory is bitwise equal to
+the pre-sharding path (pinned in tests/test_federated.py and recorded
+as ``s1_bitwise_equal`` in FEDBENCH_r01).
+
+Why selection is per shard: each shard's hierarchy sees only its column
+span, so krum's inlier geometry (and therefore which clients a bucket
+excludes) can differ shard to shard — a client can be excluded in shard
+0 and kept in shard 1. That is by design, not an approximation loss: a
+Byzantine client must now defeat S independent robust folds to corrupt
+the full vector, and each shard's f-composition contract holds verbatim
+over its own slice (every cohort member contributes exactly one row per
+shard). The flip side — a sharded fold is NOT bitwise the unsharded
+fold for S > 1 — is documented in DESIGN.md §19, measured in
+FEDBENCH_r01, and never hidden behind the S=1 anchor.
+
+Telemetry (schema v10): one ``fed_round`` event per round (cohort size,
+f budget, realized-Byzantine audit when the driver knows ground truth,
+round wall, per-shard digests) and — with ``audit=True`` — one
+``cohort`` event carrying the sampled GLOBAL client ids with their
+composed selected weights, which ``telemetry.hub.MetricsHub`` folds
+into client-id-keyed decayed suspicion (the score resampling cannot
+launder).
+"""
+
+import time
+
+import numpy as np
+
+from . import sharding
+from ..aggregators import hierarchy
+from ..telemetry import hub as tele_hub
+from ..telemetry import trace as _trace
+from ..utils import wire
+
+__all__ = ["ShardServer", "FedRoundEngine"]
+
+
+class ShardServer:
+    """One PS shard: hierarchy levels + wire plane for one column span.
+
+    ``begin_round(n, f)`` arms the reducer for the round's active cohort
+    size at the round's priced f budget; rows arrive via ``push_rows``
+    (host blocks — the fleet driver / bench path) or ``push_frame`` /
+    ``wire_transform`` (typed wire frames stamped with this shard's id;
+    the transform plugs into ``PeerExchange`` waiter threads so decode
+    and bucket folding overlap the still-open quorum, exactly like the
+    unsharded streaming path). ``finish_round`` folds the remainder and
+    returns the (d_shard,) aggregate.
+    """
+
+    def __init__(self, shard, spec, *, bucket_gar="krum", top_gar=None,
+                 bucket_size=None, levels="auto", wave_buckets=8,
+                 audit=False):
+        self.shard = sharding.shard_plane(shard, spec.num_shards)
+        self.spec = spec
+        self.d_shard = spec.width(self.shard)
+        self._cfg = dict(
+            bucket_gar=bucket_gar, top_gar=top_gar, bucket_size=bucket_size,
+            levels=levels, wave_buckets=wave_buckets, audit=audit,
+        )
+        self._red = None
+        self._round = None
+        self.wire_bytes_in = 0
+
+    # -- round lifecycle ----------------------------------------------------
+
+    def begin_round(self, round_, n, f):
+        """Arm the shard's reducer for ``n`` active cohort members at
+        the priced budget ``f``. Reuses the previous round's wave
+        buffers when (n, f) repeat — at bench scale the reallocation is
+        measurable, and plan identity keeps the fold programs cached."""
+        if self._red is not None and self._red.n == int(n) \
+                and self._red.f == int(f):
+            self._red.reset()
+        else:
+            self._red = hierarchy.StreamingAggregator(
+                int(n), int(f), **self._cfg
+            )
+        self._round = int(round_)
+        self.wire_bytes_in = 0
+        return self._red.plan
+
+    def push_rows(self, rows):
+        """Ingest a (k, d_shard) block of already-sliced cohort rows in
+        arrival order (the in-process fast path — one bulk copy into the
+        wave buffer, hierarchy.push_many)."""
+        return self._red.push_many(rows)
+
+    def push_frame(self, buf):
+        """Ingest one typed wire frame: decoded with
+        ``expect_plane=shard`` so a frame stamped for another shard is a
+        ``WireError`` — ban evidence attributable to its SENDER (the
+        stamp is under the CRC; DESIGN.md §19), not a silent mis-fold.
+        A frame may carry several whole rows (k·d_shard elements): the
+        fleet's clients batch their simulated cohort members into one
+        frame per shard per round."""
+        vec = wire.decode(buf, expect_plane=self.shard)
+        if vec.size % self.d_shard:
+            raise wire.WireError(
+                f"shard {self.shard} frame has {vec.size} elements — "
+                f"not a whole number of {self.d_shard}-wide rows"
+            )
+        self.wire_bytes_in += len(buf)
+        return self._red.push_many(vec.reshape(-1, self.d_shard))
+
+    def wire_transform(self, idx, payload):
+        """``PeerExchange`` transform hook (waiter-thread ingest +
+        overlap, like the unsharded streaming path); a WireError
+        propagates to the exchange as the peer's stored ban evidence."""
+        return self.push_frame(payload)
+
+    def arrived(self):
+        return 0 if self._red is None else self._red._arrived
+
+    def finish_round(self):
+        """Fold the remainder; returns the (d_shard,) float32 aggregate.
+        The shard's broadcast payload is exactly this span — a consumer
+        reassembles spans, it never receives the full vector from any
+        single shard."""
+        with _trace.span("fed_shard_fold", shard=int(self.shard),
+                         step=self._round):
+            return self._red.finalize()
+
+    def audit(self):
+        return self._red.audit()
+
+
+class FedRoundEngine:
+    """S in-process shard servers + the round loop (see module doc)."""
+
+    def __init__(self, model_vec, num_shards, sampler, *,
+                 bucket_gar="krum", top_gar=None, bucket_size=None,
+                 levels="auto", wave_buckets=8, lr=0.1, audit=False,
+                 telemetry=False):
+        self.model = np.asarray(model_vec, np.float32).reshape(-1).copy()
+        self.spec = sharding.plan_shards(self.model.size, num_shards)
+        self.sampler = sampler
+        self.lr = float(lr)
+        self._audit = bool(audit)
+        self._telemetry = bool(telemetry)
+        self.shards = [
+            ShardServer(s, self.spec, bucket_gar=bucket_gar,
+                        top_gar=top_gar, bucket_size=bucket_size,
+                        levels=levels, wave_buckets=wave_buckets,
+                        audit=self._audit)
+            for s in range(self.spec.num_shards)
+        ]
+        self.round = 0
+        self._active_ids = None
+        self._weights = None
+        self._pos = None  # global id -> cohort arrival position
+        self._t0 = None
+        self.last_info = None
+
+    # -- round lifecycle ----------------------------------------------------
+
+    def begin_round(self, tags=None):
+        """Sample the round's cohort, compose staleness (stragglers past
+        the cutoff are dropped BEFORE planning — zero-weight rows never
+        reach a Gram rule), price f on the active count, arm every
+        shard. Returns (active_ids, f_budget)."""
+        cohort = self.sampler.cohort(self.round)
+        active, w, dropped = self.sampler.cohort_weights(
+            self.round, cohort, tags
+        )
+        if active.size < 1:
+            raise ValueError(
+                f"round {self.round}: staleness cutoff dropped the "
+                "entire cohort"
+            )
+        f = self.sampler.f_budget(active.size)
+        self._active_ids = active
+        self._weights = w
+        self._dropped = dropped
+        self._pos = {int(c): i for i, c in enumerate(active.tolist())}
+        for sh in self.shards:
+            sh.begin_round(self.round, active.size, f)
+        self._f = f
+        self._t0 = time.perf_counter()
+        return active, f
+
+    def ingest(self, client_id, vec):
+        """One cohort member's full (d,) gradient: staleness-discounted
+        once (host-side; weight 1.0 is a bitwise no-op per IEEE
+        multiply, so fresh full-participation rounds stay on the
+        unsharded path's exact bytes), then column-sliced into every
+        shard's reducer. Rows must arrive in cohort order — arrival
+        order IS bucket assignment, shared with the unsharded path."""
+        i = self._pos[int(client_id)]
+        vec = np.asarray(vec, np.float32).reshape(-1)
+        if vec.size != self.spec.d:
+            raise ValueError(
+                f"client {client_id} gradient has {vec.size} elements, "
+                f"expected {self.spec.d}"
+            )
+        w = float(self._weights[i])
+        if w != 1.0:
+            vec = (vec * np.float32(w)).astype(np.float32)
+        for sh in self.shards:
+            sh.push_rows(self.spec.slice_rows(vec[None, :], sh.shard))
+        return i
+
+    def ingest_rows(self, rows):
+        """Bulk in-order ingest of a (k, d) block of ACTIVE cohort rows
+        (the bench/simulation fast path: rows generated wave-at-a-time,
+        weights applied in bulk)."""
+        rows = np.asarray(rows, np.float32)
+        k = rows.shape[0]
+        first = self.shards[0].arrived()
+        w = self._weights[first:first + k]
+        if not np.all(w == 1.0):
+            rows = rows * w[:, None]
+        for sh in self.shards:
+            sh.push_rows(self.spec.slice_rows(rows, sh.shard))
+        return first
+
+    def finish_round(self, *, byz_ids=None):
+        """Fold every shard, apply the model update on each span, emit
+        the v10 telemetry, advance the round counter. Returns an info
+        dict (round, cohort/active sizes, f budget, realized-Byzantine
+        audit when ``byz_ids`` ground truth is supplied, per-shard
+        latencies, wall)."""
+        per_shard = {}
+        agg_parts = []
+        for sh in self.shards:
+            t0 = time.perf_counter()
+            agg = sh.finish_round()
+            per_shard[str(sh.shard)] = {
+                "latency_s": round(time.perf_counter() - t0, 6),
+                "wire_bytes": int(sh.wire_bytes_in),
+            }
+            agg_parts.append(agg)
+        # Per-span SGD update: each shard updates only its own columns
+        # (in deployment each shard process owns its span; here the
+        # spans share one buffer). float32 throughout.
+        for sh, agg in zip(self.shards, agg_parts):
+            lo, hi = self.spec.spans[sh.shard]
+            self.model[lo:hi] = (
+                self.model[lo:hi] - np.float32(self.lr) * agg
+            ).astype(np.float32)
+        realized = None
+        exceeded = None
+        if byz_ids is not None:
+            realized = self.sampler.realized_byzantine(
+                self._active_ids, byz_ids
+            )
+            exceeded = realized > self._f
+        wall = time.perf_counter() - self._t0
+        info = {
+            "round": self.round,
+            "cohort": int(self.sampler.cohort_size),
+            "active": int(self._active_ids.size),
+            "dropped": int(self._dropped.size),
+            "f_budget": int(self._f),
+            "realized_byz": realized,
+            "budget_exceeded": exceeded,
+            "round_s": wall,
+            "per_shard": per_shard,
+        }
+        if self._telemetry:
+            tele_hub.emit_event(
+                "fed_round", step=int(self.round),
+                shards=int(self.spec.num_shards),
+                cohort=int(self._active_ids.size),
+                f_budget=int(self._f),
+                realized_byz=realized,
+                budget_exceeded=exceeded,
+                round_s=round(wall, 6),
+                per_shard=per_shard,
+            )
+            if self._audit:
+                # Composed per-client selection: a client is kept iff
+                # EVERY shard's hierarchy kept it (selection is per
+                # shard — see the module docstring), reported against
+                # the stable GLOBAL ids so resampling cannot reset it.
+                sel = np.ones(self._active_ids.size, np.float32)
+                for sh in self.shards:
+                    sel *= np.asarray(
+                        sh.audit()["selected"], np.float32
+                    )
+                tele_hub.emit_event(
+                    "cohort", step=int(self.round),
+                    client_ids=[int(c) for c in self._active_ids],
+                    selected=[float(s) for s in sel],
+                    f_budget=int(self._f),
+                )
+        self.last_info = info
+        self.round += 1
+        return info

@@ -1,0 +1,159 @@
+"""Simulated client fleets: autoscaled driver processes for fed rounds.
+
+A million-client round does not mean a million OS processes: the fleet
+is DRIVEN by a handful of client processes, each simulating a block of
+cohort members per round (generating their gradients and publishing one
+wave frame per shard — the batched form every real FL driver converges
+to). This module owns the process lifecycle and composes it with the
+load controller of ``utils.autoscale``: the round engine reports its
+round wall time, the ``AutoscaleController`` decides spawn/retire
+against the target round rate, and the fleet spawns a fresh client
+driver (re-targeting this process's own CLI at ``client:K``, the
+``worker_command`` pattern) or retires one.
+
+Retirement is abrupt by design: a fed CLIENT is stateless between
+rounds (it re-reads the broadcast model every round and carries no
+quorum obligations — unlike a cluster WORKER, whose retirement is a
+clean stop-sentinel teardown, utils/autoscale docstring), so terminate
++ exchange watcher teardown is the whole protocol; the PS's next quorum
+simply prices the smaller fleet. Each action lands as the existing
+``autoscale`` telemetry event (schema v6) so the fed plane reuses the
+spawns/retires digest and Prometheus counters unchanged.
+"""
+
+import subprocess
+
+from ..telemetry import hub as tele_hub
+from ..utils import autoscale as autoscale_lib
+
+__all__ = ["ClientFleet", "client_command"]
+
+
+def client_command(cindex, argv=None, main_module=None):
+    """This process's CLI re-targeted at the ``client:cindex`` role —
+    ``utils.autoscale.worker_command`` with the fed client role (the
+    PS-only autoscale knobs are stripped the same way)."""
+    return autoscale_lib.worker_command(
+        cindex, argv=argv, main_module=main_module, role="client"
+    )
+
+
+class ClientFleet:
+    """Elastic pool of simulated client driver processes.
+
+    ``command_for(index)`` builds a child's argv (usually via
+    ``client_command``); ``cfg`` is the ``AutoscaleConfig`` contract.
+    The fleet spawns the lowest free index (stable rank reuse — a
+    respawned index rejoins the exchange through the same host slot)
+    and retires the highest live one.
+    """
+
+    def __init__(self, command_for, cfg, *, env=None, on_retire=None):
+        self.command_for = command_for
+        self.controller = autoscale_lib.AutoscaleController(cfg)
+        self.cfg = cfg
+        self.spawns = 0
+        self.retires = 0
+        self._env = env
+        self._on_retire = on_retire
+        self._procs = {}
+
+    # -- membership ---------------------------------------------------------
+
+    def active(self):
+        return sorted(
+            k for k, p in self._procs.items() if p.poll() is None
+        )
+
+    def spawn(self, index):
+        if index in self._procs and self._procs[index].poll() is None:
+            return self._procs[index]
+        p = subprocess.Popen(self.command_for(index), env=self._env)
+        self._procs[index] = p
+        self.spawns += 1
+        tele_hub.emit_event(
+            "autoscale", action="spawn", rank=int(index),
+            active=len(self.active()), rate=self.controller.rate(),
+            target=self.controller.target or None,
+        )
+        return p
+
+    def spawn_initial(self, count):
+        for k in range(count):
+            self.spawn(k)
+        return self.active()
+
+    def retire(self, index=None):
+        live = self.active()
+        if not live:
+            return None
+        index = live[-1] if index is None else index
+        p = self._procs.get(index)
+        if p is None:
+            return None
+        if self._on_retire is not None:
+            try:
+                self._on_retire(index)
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+        if p.poll() is None:
+            p.terminate()
+            try:
+                # Block until the process is actually gone: ``active()``
+                # is poll()-based, and a PS that counts a half-dead
+                # driver into its next quorum waits the full round
+                # timeout for a frame that will never come.
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.retires += 1
+        tele_hub.emit_event(
+            "autoscale", action="retire", rank=int(index),
+            active=len(self.active()), rate=self.controller.rate(),
+            target=self.controller.target or None,
+        )
+        return index
+
+    # -- the control loop ---------------------------------------------------
+
+    def observe(self, round_s, *, quorum_margin=0):
+        """Fold one round into the controller; act on its verdict.
+        Returns ``(action, index)``: +1/-1/0 (the action TAKEN, not just
+        advised) and the spawned/retired driver index (None on 0) — the
+        caller must drop a retired index from its own round membership
+        immediately, before the next quorum prices it in."""
+        action = self.controller.observe(
+            round_s, active=len(self.active()),
+            quorum_margin=quorum_margin,
+        )
+        if action > 0:
+            live = set(self.active())
+            free = 0
+            while free in live:
+                free += 1
+            if free >= self.cfg.max_workers:
+                return 0, None
+            self.spawn(free)
+            return action, free
+        if action < 0:
+            return action, self.retire()
+        return 0, None
+
+    # -- teardown -----------------------------------------------------------
+
+    def stop_all(self, timeout=30):
+        for k, p in list(self._procs.items()):
+            if p.poll() is None:
+                p.terminate()
+        for p in self._procs.values():
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._procs.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop_all()

@@ -100,11 +100,25 @@ SCHEMA = "garfield-telemetry"
 # report attributable lift, not raw rate), and ``defense_bench``
 # ``defense`` strings may name the composed modes (``data``/
 # ``escalate+data``).
-SCHEMA_VERSION = 9
+# v10 (round 17, the federated round engine — DESIGN.md §19): the
+# ``fed_round`` EVENT (one sharded federated round: shard count, active
+# cohort size, the cohort's priced ``f_budget``, the simulation-side
+# ``realized_byz``/``budget_exceeded`` audit, round wall and a
+# ``per_shard`` digest of per-shard fold latencies and wire bytes), the
+# ``cohort`` EVENT (the audited cohort's stable GLOBAL ``client_ids``
+# with their composed ``selected`` weights — what the hub's
+# client-id-keyed decayed suspicion folds, the score resampling cannot
+# launder), ``summary`` gained the optional ``federated`` digest
+# (rounds/shards/last_cohort/f_budget/budget_exceeded/mean_round_s +
+# ``top_clients``), the ``garfield_fed_*`` /
+# ``garfield_client_suspicion_decayed`` Prometheus series, and the new
+# ``fed_bench`` kind (FEDBENCH_r*'s rows: the 1/S shard-scaling cells,
+# the S=1 bitwise anchor, the autoscaled fleet-rate cells).
+SCHEMA_VERSION = 10
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
          "transfer_bench", "exchange_bench", "hier_bench", "span",
-         "defense_bench")
+         "defense_bench", "fed_bench")
 
 
 def make_record(kind, **fields):
@@ -333,6 +347,66 @@ def validate_record(rec):
                         f"attack_fallback.{key} must be a string, got "
                         f"{rec.get(key)!r}"
                     )
+        elif rec.get("event") == "fed_round":
+            # v10: one sharded federated round (federated/engine.py).
+            for key in ("shards", "cohort"):
+                val = rec.get(key)
+                if not isinstance(val, int) or isinstance(val, bool) \
+                        or val < 1:
+                    _fail(
+                        f"fed_round.{key} must be a positive int, "
+                        f"got {val!r}"
+                    )
+            for key in ("step", "f_budget", "realized_byz"):
+                val = rec.get(key)
+                if val is not None and (
+                    not isinstance(val, int) or isinstance(val, bool)
+                    or val < 0
+                ):
+                    _fail(
+                        f"fed_round.{key} must be a non-negative int or "
+                        f"null, got {val!r}"
+                    )
+            be = rec.get("budget_exceeded")
+            if be is not None and not isinstance(be, bool):
+                _fail(
+                    f"fed_round.budget_exceeded must be a bool or null, "
+                    f"got {be!r}"
+                )
+            rs = rec.get("round_s")
+            if rs is not None and not _is_num(rs):
+                _fail(
+                    f"fed_round.round_s must be a number or null, "
+                    f"got {rs!r}"
+                )
+            ps = rec.get("per_shard")
+            if ps is not None:
+                if not isinstance(ps, dict) or not all(
+                    isinstance(v, dict) and all(
+                        x is None or _is_num(x) for x in v.values()
+                    )
+                    for v in ps.values()
+                ):
+                    _fail(
+                        f"fed_round.per_shard must map shard ids to "
+                        f"numeric digests, got {ps!r}"
+                    )
+        elif rec.get("event") == "cohort":
+            # v10: the audited cohort — stable global client ids with
+            # their composed selected weights (parallel lists).
+            ids = rec.get("client_ids")
+            _check_float_list("cohort", "client_ids", ids)
+            sel = rec.get("selected")
+            if sel is not None:
+                _check_float_list("cohort", "selected", sel, len(ids))
+            fb = rec.get("f_budget")
+            if fb is not None and (
+                not isinstance(fb, int) or isinstance(fb, bool) or fb < 0
+            ):
+                _fail(
+                    f"cohort.f_budget must be a non-negative int or "
+                    f"null, got {fb!r}"
+                )
         elif rec.get("event") == "autoscale":
             # v6: one elastic-membership action (DESIGN.md §15).
             if rec.get("action") not in ("spawn", "retire"):
@@ -497,6 +571,28 @@ def validate_record(rec):
                     f"summary.staleness.hist must map staleness to "
                     f"counts, got {hist!r}"
                 )
+        fed = rec.get("federated")
+        if fed is not None:
+            # v10: the federated-round digest (hub.federated_stats).
+            if not isinstance(fed, dict):
+                _fail(f"summary.federated must be an object, got {fed!r}")
+            for key in ("rounds", "budget_exceeded"):
+                val = fed.get(key)
+                if not isinstance(val, int) or isinstance(val, bool) \
+                        or val < 0:
+                    _fail(
+                        f"summary.federated.{key} must be a non-negative "
+                        f"int, got {val!r}"
+                    )
+            tc = fed.get("top_clients")
+            if tc is not None and (
+                not isinstance(tc, dict)
+                or not all(_is_num(v) for v in tc.values())
+            ):
+                _fail(
+                    f"summary.federated.top_clients must map client ids "
+                    f"to numbers, got {tc!r}"
+                )
         asd = rec.get("autoscale")
         if asd is not None:
             # v6: the elastic-membership digest (hub.autoscale_stats).
@@ -614,6 +710,61 @@ def validate_record(rec):
             _fail(
                 f"defense_bench.escalations must be a non-negative int "
                 f"or null, got {esc!r}"
+            )
+    elif kind == "fed_bench":
+        # v10: one FEDBENCH_r* row — a shard-scaling cell (check
+        # "scaling"), the S=1 bitwise anchor ("s1_bitwise"), or an
+        # autoscaled fleet-rate cell ("fleet").
+        if not isinstance(rec.get("check"), str) or not rec["check"]:
+            _fail(
+                f"fed_bench.check must be a non-empty string, got "
+                f"{rec.get('check')!r}"
+            )
+        for key in ("n", "d", "shards"):
+            val = rec.get(key)
+            if not isinstance(val, int) or isinstance(val, bool) \
+                    or val < 1:
+                _fail(
+                    f"fed_bench.{key} must be a positive int, got {val!r}"
+                )
+        if not isinstance(rec.get("gar"), str):
+            _fail(f"fed_bench.gar must be a string, got {rec.get('gar')!r}")
+        for key in ("population", "f", "rounds", "spawns", "retires",
+                    "active_initial", "active_final"):
+            val = rec.get(key)
+            if val is not None and (
+                not isinstance(val, int) or isinstance(val, bool)
+                or val < 0
+            ):
+                _fail(
+                    f"fed_bench.{key} must be a non-negative int or "
+                    f"null, got {val!r}"
+                )
+        for key in ("round_s", "round_s_sum", "speedup", "per_client_s",
+                    "target_rate", "achieved_rate", "pre_rate",
+                    "recovered_rate"):
+            val = rec.get(key)
+            if val is not None and not _is_num(val):
+                _fail(
+                    f"fed_bench.{key} must be a number or null, got {val!r}"
+                )
+        for key in ("per_shard_s", "per_shard_rss"):
+            val = rec.get(key)
+            if val is not None:
+                _check_float_list("fed_bench", key, val)
+        for key in ("s1_bitwise_equal", "budget_exceeded"):
+            val = rec.get(key)
+            if val is not None and not isinstance(val, bool):
+                _fail(
+                    f"fed_bench.{key} must be a bool or null, got {val!r}"
+                )
+        rss = rec.get("peak_rss_bytes")
+        if rss is not None and (
+            not isinstance(rss, int) or isinstance(rss, bool) or rss < 0
+        ):
+            _fail(
+                f"fed_bench.peak_rss_bytes must be a non-negative int "
+                f"or null, got {rss!r}"
             )
     elif kind == "transfer_bench":
         for key in ("devices", "d"):
@@ -856,6 +1007,32 @@ def prometheus_text(hub):
                "Autoscale membership actions taken.",
                [({"action": "spawn"}, float(autos["spawns"])),
                 ({"action": "retire"}, float(autos["retires"]))])
+    fed = hub.federated_stats()
+    if fed is not None:
+        # v10: the federated round engine (DESIGN.md §19).
+        metric("garfield_fed_rounds_total", "counter",
+               "Federated rounds completed by the sharded round engine.",
+               [({}, float(fed["rounds"]))])
+        if fed["shards"] is not None:
+            metric("garfield_fed_shards", "gauge",
+                   "PS shard count of the federated deployment.",
+                   [({}, float(fed["shards"]))])
+        if fed["last_cohort"] is not None:
+            metric("garfield_fed_cohort_size", "gauge",
+                   "Active cohort size of the last federated round.",
+                   [({}, float(fed["last_cohort"]))])
+        metric("garfield_fed_budget_exceeded_total", "counter",
+               "Rounds whose realized Byzantine count exceeded the "
+               "cohort's priced f budget (simulation audit).",
+               [({}, float(fed["budget_exceeded"]))])
+        top = hub.client_suspicion_decayed(k=16)
+        if top:
+            metric("garfield_client_suspicion_decayed", "gauge",
+                   "Decayed exclusion frequency of the most-suspect "
+                   "sampled clients, keyed by stable GLOBAL client id "
+                   "(v10; resampling cannot launder it).",
+                   [({"client": str(c)}, float(s))
+                    for c, s in sorted(top.items())])
     dfs = hub.defense_stats()
     if dfs is not None:
         # v7: the closed-loop defense (DESIGN.md §16).

@@ -88,6 +88,24 @@ class MetricsHub:
             "rounds": 0, "flagged": 0, "max_score": None, "min_w": None,
             "scores": {},
         }
+        # Federated round accounting (schema v10, DESIGN.md §19): folded
+        # from the round engine's ``fed_round``/``cohort`` events.
+        # Client suspicion is keyed by the STABLE GLOBAL client id, not
+        # the per-round cohort index: under partial participation a
+        # cohort index means a different client every round, so indexing
+        # suspicion by it hands every resampled Byzantine client a fresh
+        # ledger — the sampling-scale twin of the rotation laundering
+        # the halflife window closes (pinned by the rotating-attacker
+        # regression in tests/test_federated.py). The map is sparse
+        # (only sampled-and-audited clients appear) with lazily applied
+        # decay per cohort event, so a million-client population costs
+        # only its audited cohorts.
+        self._clients = {}  # cid -> [obs_d, exc_d, last_cohort_event]
+        self._cohort_events = 0
+        self._fed = {
+            "rounds": 0, "shards": None, "last_cohort": None,
+            "budget_exceeded": 0, "round_s_sum": 0.0, "f_budget": None,
+        }
         # Targeted-attack eval accounting (schema v8, DESIGN.md §17):
         # folded from ``targeted_eval`` events — the per-class digest the
         # divergence-blind suspicion plane cannot produce.
@@ -341,6 +359,50 @@ class MetricsHub:
                     t["last_confusion"] = float(fields["confusion"])
                 if fields.get("asr") is not None:
                     t["last_asr"] = float(fields["asr"])
+            elif kind == "fed_round":
+                # v10: one federated round (federated/engine.py) —
+                # digest counters for the summary + Prometheus.
+                fd = self._fed
+                fd["rounds"] += 1
+                if fields.get("shards") is not None:
+                    fd["shards"] = int(fields["shards"])
+                if fields.get("cohort") is not None:
+                    fd["last_cohort"] = int(fields["cohort"])
+                if fields.get("f_budget") is not None:
+                    fd["f_budget"] = int(fields["f_budget"])
+                if fields.get("budget_exceeded"):
+                    fd["budget_exceeded"] += 1
+                if fields.get("round_s") is not None:
+                    fd["round_s_sum"] += float(fields["round_s"])
+            elif kind == "cohort":
+                # v10: one audited cohort — per-CLIENT observed/selected
+                # keyed by stable global ids (see __init__'s comment on
+                # why NOT cohort index). Lazy decay: a client's twins
+                # decay by decay**(events since it was last sampled)
+                # before the new observation folds in, so untouched
+                # entries cost nothing per event.
+                ids = fields.get("client_ids") or ()
+                sel = fields.get("selected")
+                if ids:
+                    self._cohort_events += 1
+                    now = self._cohort_events
+                    if sel is None or len(sel) != len(ids):
+                        sel = [1.0] * len(ids)
+                    for cid, s in zip(ids, sel):
+                        ent = self._clients.get(int(cid))
+                        if ent is None:
+                            ent = self._clients[int(cid)] = [0.0, 0.0, now]
+                        elif self._halflife is not None:
+                            k = now - ent[2]
+                            if k:
+                                dk = self._susp_decay ** k
+                                ent[0] *= dk
+                                ent[1] *= dk
+                            ent[2] = now
+                        else:
+                            ent[2] = now
+                        ent[0] += 1.0
+                        ent[1] += max(0.0, 1.0 - float(s))
             elif kind == "hier_exclusion":
                 # The hierarchical reducer's per-client audit (aggregators/
                 # hierarchy.py): observed/selected weight vectors over the
@@ -427,6 +489,46 @@ class MetricsHub:
             if self._halflife is None:
                 return self._excluded / np.maximum(self._observed, 1e-9)
             return self._excluded_d / np.maximum(self._observed_d, 1e-9)
+
+    def client_suspicion_decayed(self, k=None):
+        """Per-CLIENT decayed exclusion frequency over the sampled
+        cohorts, keyed by stable GLOBAL client id ({cid: score}), or
+        None before any cohort event. Entries not sampled recently are
+        decayed to 'now' on read (numerator and denominator by the same
+        factor — the RATIO is sampling-gap-invariant, so a Byzantine
+        client cannot shrink its score by being resampled later; what
+        the halflife does change is how fast old exclusions stop
+        counting, same law as ``suspicion_decayed``). ``k`` returns only
+        the top-k by score."""
+        with self._lock:
+            if not self._clients:
+                return None
+            out = {
+                cid: (exc / max(obs, 1e-9))
+                for cid, (obs, exc, _) in self._clients.items()
+            }
+        if k is not None:
+            top = sorted(out.items(), key=lambda kv: -kv[1])[:int(k)]
+            return dict(top)
+        return out
+
+    def federated_stats(self):
+        """Federated-round digest (schema v10), or None when no
+        ``fed_round`` event was folded (non-federated runs)."""
+        with self._lock:
+            fd = self._fed
+            if not fd["rounds"]:
+                return None
+            return {
+                "rounds": int(fd["rounds"]),
+                "shards": fd["shards"],
+                "last_cohort": fd["last_cohort"],
+                "f_budget": fd["f_budget"],
+                "budget_exceeded": int(fd["budget_exceeded"]),
+                "mean_round_s": round(
+                    fd["round_s_sum"] / fd["rounds"], 6
+                ),
+            }
 
     def defense_stats(self):
         """Suspicion-weight digest + escalation state of the closed-loop
@@ -662,6 +764,18 @@ class MetricsHub:
             }
         stale = self.staleness_stats()
         autos = self.autoscale_stats()
+        fed = self.federated_stats()
+        if fed is not None:
+            # v10: top sampled-client suspects ride the digest (the full
+            # sparse map serves the Prometheus gauge only — a summary
+            # must stay bounded at million-client populations).
+            top = self.client_suspicion_decayed(k=8) or {}
+            fed = {
+                **fed,
+                "top_clients": {
+                    str(cid): round(s, 6) for cid, s in top.items()
+                },
+            }
         wire_planes = self.wire_plane_counters()
         phases = self.phase_stats()
         if phases is not None:
@@ -740,6 +854,9 @@ class MetricsHub:
                 # schema v6: elastic-membership digest (None on
                 # fixed-membership runs).
                 autoscale=autos,
+                # schema v10: federated-round digest + top sampled-client
+                # suspects (None on non-federated runs).
+                federated=fed,
                 meta=self.meta,
             )
 

@@ -184,8 +184,8 @@ _PS_ONLY_VALUED = (
 _PS_ONLY_FLAGS = ("--autoscale",)
 
 
-def worker_command(windex, argv=None, main_module=None):
-    """This process's CLI, re-targeted at the ``worker:windex`` role.
+def worker_command(windex, argv=None, main_module=None, role="worker"):
+    """This process's CLI, re-targeted at the ``{role}:windex`` role.
 
     The PS was launched as ``python -m garfield_tpu.apps.<app> --cluster
     ... --task ps:0 ...``; a spawned worker runs the SAME app with the
@@ -194,6 +194,9 @@ def worker_command(windex, argv=None, main_module=None):
     PS-only autoscale knobs, plus its own ``--task``. The module name
     comes from ``__main__.__spec__`` (set by ``-m`` execution); running
     the PS some other way must pass ``main_module`` explicitly.
+    ``role`` generalizes the task name — the federated fleet spawns
+    ``client:K`` drivers through the same derivation
+    (federated/fleet.client_command).
     """
     if main_module is None:
         spec = getattr(sys.modules.get("__main__"), "__spec__", None)
@@ -222,4 +225,4 @@ def worker_command(windex, argv=None, main_module=None):
         out.append(a)
         i += 1
     return [sys.executable, "-m", main_module, *out,
-            "--task", f"worker:{int(windex)}"]
+            "--task", f"{role}:{int(windex)}"]

@@ -189,6 +189,27 @@ class PeerExchange:
         """Register slot of (peer ``idx``, ``plane``)."""
         return plane * self.n + idx
 
+    def _check_plane(self, plane):
+        """Loud capacity guard for every plane-taking entry point: the
+        plane/shard tag rides a spare nibble end to end (transport
+        header high byte here, wire codec header nibble — DESIGN.md
+        §15/§19), so an out-of-range id must fail at the CALL SITE that
+        would stamp it. Silently truncating (or indexing a register
+        slot past ``n * planes``) would deliver one shard's frames into
+        another shard's fold — the exact corruption the shard stamp
+        exists to make attributable."""
+        if isinstance(plane, bool) or not isinstance(plane, int):
+            raise TypeError(
+                f"plane/shard tag must be an integer, got {plane!r}"
+            )
+        if not 0 <= plane < self.planes:
+            raise ValueError(
+                f"plane/shard tag {plane} out of range for a "
+                f"{self.planes}-plane exchange (build with planes=P to "
+                "widen, max 16 — the wire header nibble)"
+            )
+        return plane
+
     def _peer_loop(self, conn):
         try:
             while not self._closing.is_set():
@@ -351,12 +372,7 @@ class PeerExchange:
         RPC pulls.
         """
         payload = bytes(payload)
-        plane = int(plane)
-        if not 0 <= plane < self.planes:
-            raise ValueError(
-                f"plane {plane} out of range for a {self.planes}-plane "
-                "exchange"
-            )
+        plane = self._check_plane(plane)
         targets = range(self.n) if to is None else to
         with _trace.span(
             "publish", step=int(step), nbytes=len(payload), plane=plane,
@@ -490,6 +506,7 @@ class PeerExchange:
         """
         if step >= _CLOSE_STEP:
             raise ValueError(f"step {step} reserved for the close sentinel")
+        plane = self._check_plane(plane)
         peers = list(range(self.n)) if peers is None else list(peers)
         if q > len(peers):
             raise ValueError(f"q={q} exceeds the {len(peers)} waited peers")
@@ -612,6 +629,7 @@ class PeerExchange:
         retires it WITHOUT harvesting — the role-shutdown lifecycle
         contract shared with ``collect_begin``.
         """
+        plane = self._check_plane(plane)
         state = {"best": None}
         cond = threading.Condition()
         harvested = threading.Event()
@@ -697,6 +715,7 @@ class PeerExchange:
         soon as the current or a newly-written frame satisfies the bound;
         raises TimeoutError otherwise.
         """
+        plane = self._check_plane(plane)
         deadline = time.monotonic() + timeout_ms / 1000.0
         version = 0
         while not self._closing.is_set():

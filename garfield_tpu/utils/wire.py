@@ -59,6 +59,7 @@ __all__ = [
     "WIRE_DTYPES",
     "WireError",
     "wire_dtype",
+    "check_plane",
     "encode",
     "decode",
     "frame_plane",
@@ -110,20 +111,42 @@ def _bf16_to_f32(u16):
     return (u16.astype(np.uint32) << np.uint32(16)).view(np.float32)
 
 
+def check_plane(plane, what="plane"):
+    """Validate a plane/shard tag for the header's spare nibble; returns
+    it as an int. The tag has FOUR bits — the federated engine rides
+    shard ids on it (federated/sharding.py) — so an id past 15 must
+    fail HERE, at stamp time, with the capacity named: masking it into
+    the nibble would silently deliver one shard's frames to another
+    (the exact cross-shard mis-fold the stamp exists to make
+    attributable). Non-integral tags (bools, floats) are rejected too:
+    ``int(3.7)`` truncating to plane 3 is the same silent corruption.
+    """
+    if isinstance(plane, bool) or not isinstance(plane, (int, np.integer)):
+        raise TypeError(
+            f"{what} tag must be an integer, got {plane!r}"
+        )
+    plane = int(plane)
+    if not 0 <= plane <= MAX_PLANE:
+        raise ValueError(
+            f"{what} tag {plane} does not fit the wire header's spare "
+            f"nibble [0, {MAX_PLANE}] — a larger plane/shard space needs "
+            "a wider header (new wire version), not a truncated tag"
+        )
+    return plane
+
+
 def encode(vec, dtype=None, *, plane=0):
     """Encode a flat float32 vector as one typed frame.
 
     ``dtype`` overrides the env-configured send width. f32 payload bytes
     are the exact ``vec.tobytes()`` of the pre-codec format. ``plane``
     (0..15) stamps the header's spare high-nibble plane tag — plane 0
-    keeps the frame byte-identical to the pre-plane format.
+    keeps the frame byte-identical to the pre-plane format. Out-of-range
+    or non-integral tags fail loudly (``check_plane``), never truncate.
     """
     vec = np.ascontiguousarray(np.asarray(vec).reshape(-1), np.float32)
     dtype = wire_dtype() if dtype is None else dtype
-    if not 0 <= int(plane) <= MAX_PLANE:
-        raise ValueError(
-            f"plane must be in [0, {MAX_PLANE}], got {plane}"
-        )
+    plane = check_plane(plane)
     if dtype == "bf16":
         payload = _f32_to_bf16(vec).tobytes()
         tag = _TAG_BF16
@@ -133,12 +156,12 @@ def encode(vec, dtype=None, *, plane=0):
     else:
         raise ValueError(f"unknown wire dtype {dtype!r}")
     return _HDR.pack(
-        _MAGIC, _VERSION, tag | (int(plane) << 4), vec.size,
+        _MAGIC, _VERSION, tag | (plane << 4), vec.size,
         zlib.crc32(payload),
     ) + payload
 
 
-def decode(buf):
+def decode(buf, *, expect_plane=None):
     """Decode a typed frame back to a float32 vector; raises WireError.
 
     Validation order matters for the ban path: header shape first (magic,
@@ -147,6 +170,14 @@ def decode(buf):
     at least one of these (a payload flip breaks the CRC; a header flip
     breaks magic/version/tag/length), so corrupted bytes can never reach
     a GAR (fuzzed in tests/test_wire.py).
+
+    ``expect_plane`` makes the plane/shard stamp load-bearing for the
+    federated shard plane (DESIGN.md §19): a consumer that owns plane
+    ``s`` rejects frames stamped for any other plane as a codec failure
+    — and since the stamp sits in the sender-controlled header, the
+    mismatch is attributable ban evidence against the SENDER (a correct
+    transport cannot restamp it without also failing magic/CRC), not a
+    routing accident to shrug off.
     """
     if len(buf) < HEADER_NBYTES:
         raise WireError(
@@ -158,6 +189,14 @@ def decode(buf):
         raise WireError(f"bad magic {magic!r}")
     if ver != _VERSION:
         raise WireError(f"unsupported wire version {ver}")
+    if expect_plane is not None and (tag >> 4) != check_plane(
+        expect_plane, "expect_plane"
+    ):
+        raise WireError(
+            f"frame stamped for plane/shard {tag >> 4} arrived at a "
+            f"consumer of plane/shard {int(expect_plane)} — cross-shard "
+            "delivery, attributable to the sender"
+        )
     tag &= 0x0F  # the high nibble is the plane tag (frame_plane)
     if tag not in _ITEMSIZE:
         raise WireError(f"unknown dtype tag {tag}")

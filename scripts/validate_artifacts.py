@@ -18,7 +18,11 @@ plane/confusion/asr columns) — and the v9 data-plane-defense additions
 (the data_defense event with matched-length scores/flags/weights/ranks
 lists, summary.data_defense, the asr_baseline field on targeted_eval
 events and DEFBENCH_r03's defense_bench rows with the composed
-data/escalate+data defense strings).
+data/escalate+data defense strings) — and the v10 federated additions
+(the ``fed_bench`` kind behind FEDBENCH_r*'s scaling / s1_bitwise /
+fleet rows, the ``fed_round`` event with its per-shard digest, the
+``cohort`` event's matched-length client_ids/selected lists, and
+``summary.federated`` with its client-id-keyed top_clients map).
 
   python scripts/validate_artifacts.py            # repo root auto-found
   python scripts/validate_artifacts.py /some/repo

@@ -80,7 +80,8 @@ _RUN_LAST = {
     "test_async_cluster.py": 4,
     "test_defense_cluster.py": 5,
     "test_dataplane_cluster.py": 6,
-    "test_apps.py": 7,
+    "test_fed_cluster.py": 7,
+    "test_apps.py": 8,
 }
 
 # Tier-1 wall-clock budget of the verify command (ROADMAP.md): the

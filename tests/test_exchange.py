@@ -417,17 +417,39 @@ def test_per_plane_slots_do_not_overwrite_each_other():
 
 
 def test_plane_out_of_range_rejected():
+    """ISSUE 13 satellite (boundary): the plane/shard tag rides spare
+    header bits, so EVERY plane-taking entry point must fail loudly at
+    the exact capacity boundary — a silently truncated tag would
+    deliver one shard's frames into another shard's fold."""
     peers = _mesh_planes(2, 2)
     try:
+        # In-range boundary works...
+        peers[0].publish(1, b"ok", plane=1)
+        # ...one past it fails on every entry point, loudly.
         with pytest.raises(ValueError):
             peers[0].publish(1, b"x", plane=2)
         with pytest.raises(ValueError):
             peers[0].round_collector([1], plane=5)
+        with pytest.raises(ValueError):
+            peers[0].collect_begin(1, q=1, peers=[1], plane=2)
+        with pytest.raises(ValueError):
+            peers[0].read_latest_begin(1, 0, plane=2)
+        with pytest.raises(ValueError):
+            peers[0].read_latest(1, 0, plane=2, timeout_ms=10)
+        with pytest.raises(ValueError):
+            peers[0].publish(1, b"x", plane=-1)
+        # Non-integral tags are rejected, not int()-truncated.
+        with pytest.raises(TypeError):
+            peers[0].publish(1, b"x", plane=1.5)
     finally:
         for p in peers:
             p.close()
     with pytest.raises(ValueError):
         PeerExchange(0, ["127.0.0.1:1"], planes=0)
+    # The exchange's plane space is capped at the wire header nibble's
+    # 16 values — planes=17 must be refused at construction.
+    with pytest.raises(ValueError):
+        PeerExchange(0, ["127.0.0.1:1"], planes=17)
 
 
 def test_round_collectors_per_plane_independent():

@@ -1,0 +1,174 @@
+"""Multi-process federated deployment e2e (slow, conftest._RUN_LAST).
+
+The real thing at small scale: shard PS planes over PeerExchange with
+shard-stamped wire frames (cross-shard arrivals attributed to their
+sender), the fed_bench shard-process scaling cells, and the autoscaled
+jax-free client fleet driving rounds against a rate target.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from garfield_tpu import federated as fed
+from garfield_tpu.apps.benchmarks import fed_bench
+from garfield_tpu.utils import wire
+from garfield_tpu.utils.exchange import PeerExchange
+
+pytestmark = pytest.mark.slow
+
+
+def _hosts(k):
+    return [f"127.0.0.1:{p}" for p in fed_bench._ports(k)]
+
+
+class TestShardWirePlane:
+    def test_cross_shard_frame_is_attributable_ban_evidence(self):
+        """A client that stamps its frame for the WRONG shard (or
+        garbles it) is excluded with the evidence stored against ITS
+        slot — the shard plane's twin of the cluster quorum ban."""
+        hosts = _hosts(3)  # rank 0 = shard PS, ranks 1..2 = clients
+        spec = fed.plan_shards(40, 2)
+        ps = PeerExchange(0, hosts, planes=2)
+        c1 = PeerExchange(1, hosts, planes=2)
+        c2 = PeerExchange(2, hosts, planes=2)
+        try:
+            server = fed.ShardServer(1, spec, bucket_gar="average")
+            server.begin_round(0, 2, 0)
+            wait = ps.collect_begin(
+                5, 2, peers=[1, 2], timeout_ms=30_000,
+                transform=server.wire_transform, plane=1,
+            )
+            rows = np.ones((2, 40), np.float32)
+            good = spec.slice_rows(rows, 1)[0]
+            # Client 1: honest shard-1 frame. Client 2: frame stamped
+            # for shard 0 — cross-shard delivery.
+            c1.publish(5, wire.encode(good, plane=1), to=[0], plane=1)
+            c2.publish(
+                5, wire.encode(spec.slice_rows(rows, 0)[0], plane=0),
+                to=[0], plane=1,
+            )
+            got = wait()
+            assert not isinstance(got[1], Exception)
+            assert isinstance(got[2], wire.WireError)
+            assert "cross-shard" in str(got[2])
+        finally:
+            for ex in (ps, c1, c2):
+                ex.close()
+
+    def test_two_shard_round_over_real_wire(self):
+        """Both shards of one round over real sockets: per-shard
+        collects on per-shard planes, reassembled model bitwise equal
+        to the in-process engine over the same rows."""
+        hosts = _hosts(2)
+        d, n = 64, 4
+        spec = fed.plan_shards(d, 2)
+        ps = PeerExchange(0, hosts, planes=2)
+        cl = PeerExchange(1, hosts, planes=2)
+        try:
+            rows = np.random.default_rng(3).normal(
+                size=(n, d)).astype(np.float32)
+            servers = [
+                fed.ShardServer(s, spec, bucket_gar="average")
+                for s in range(2)
+            ]
+            for sv in servers:
+                sv.begin_round(0, n, 0)
+            waits = [
+                ps.collect_begin(
+                    1, 1, peers=[1], timeout_ms=30_000,
+                    transform=sv.wire_transform, plane=sv.shard,
+                )
+                for sv in servers
+            ]
+            for s in range(2):
+                cl.publish(
+                    1,
+                    wire.encode(spec.slice_rows(rows, s).ravel(),
+                                plane=s),
+                    to=[0], plane=s,
+                )
+            for w in waits:
+                got = w()
+                assert not any(
+                    isinstance(v, Exception) for v in got.values()
+                )
+            agg = fed.reassemble(
+                spec, [sv.finish_round() for sv in servers]
+            )
+            np.testing.assert_allclose(
+                agg, rows.mean(axis=0), rtol=1e-5, atol=1e-6
+            )
+        finally:
+            ps.close()
+            cl.close()
+
+
+class TestFedBenchEndToEnd:
+    def test_scaling_cells_spawn_shard_processes(self, tmp_path):
+        """fed_bench's scaling mode at toy scale: one OS process per
+        (cell, shard), S=1 vs S=2 rows with sane fields + the schema-
+        valid JSONL twin."""
+        out = tmp_path / "FED.json"
+        rows = fed_bench.main([
+            "--n", "2048", "--population", "4096", "--d", "1000",
+            "--shards_list", "1", "2", "--scaling_gars", "median",
+            "--rounds", "1",
+            "--bitwise_n", "256", "--bitwise_d", "500",
+            "--skip_fleet", "--json", str(out),
+        ])
+        by_check = {}
+        for r in rows:
+            by_check.setdefault(r["check"], []).append(r)
+        assert by_check["s1_bitwise"][0]["s1_bitwise_equal"] is True
+        scaling = {r["shards"]: r for r in by_check["scaling"]}
+        assert set(scaling) == {1, 2}
+        assert len(scaling[2]["per_shard_s"]) == 2
+        assert scaling[2]["round_s"] <= scaling[1]["round_s"] * 1.05
+        from garfield_tpu.telemetry import exporters
+
+        assert exporters.validate_jsonl(str(tmp_path / "FED.jsonl")) == 3
+        dumped = json.loads(out.read_text())
+        assert len(dumped) == 3
+
+    def test_autoscaled_fleet_reaches_target(self):
+        """The fleet scenario end to end: jax-free client drivers over
+        real sockets, the autoscale controller spawning toward a rate
+        target the initial fleet cannot meet."""
+        row = fed_bench.main([
+            "--skip_scaling", "--skip_bitwise",
+            "--fleet_rounds", "40", "--fleet_cohort", "32",
+            "--fleet_d", "1000", "--fleet_delay_ms", "8",
+        ])[0]
+        assert row["check"] == "fleet"
+        assert row["spawns"] >= 1, row
+        assert row["active_final"] > row["active_initial"]
+        assert row["recovered_rate"] > row["pre_rate"], row
+
+
+class TestFleetProcessLifecycle:
+    def test_client_fleet_spawn_retire_reaps_processes(self):
+        sleeper = [sys.executable, "-c", "import time; time.sleep(60)"]
+        from garfield_tpu.utils import autoscale as autoscale_lib
+
+        fleet = fed.ClientFleet(
+            lambda k: sleeper,
+            autoscale_lib.AutoscaleConfig(
+                target_rate=1.0, min_workers=1, max_workers=3,
+                window=2, cooldown=0,
+            ),
+        )
+        try:
+            fleet.spawn_initial(2)
+            assert fleet.active() == [0, 1]
+            idx = fleet.retire()
+            assert idx == 1 and fleet.active() == [0]
+            # retire() joins: the process is actually gone, not dying.
+            assert fleet._procs[1].poll() is not None
+        finally:
+            fleet.stop_all()
+        assert fleet.active() == []

@@ -89,6 +89,41 @@ def test_plane_tag_round_trip():
         wire.frame_plane(b"XX" + b"\0" * 14)  # bad magic
 
 
+def test_plane_capacity_guard_boundary():
+    """ISSUE 13 satellite: the plane/shard tag has exactly
+    ``MAX_PLANE + 1`` values — the boundary encodes, one past it fails
+    loudly at publish/encode time (named capacity in the message), and
+    non-integral tags are rejected instead of int()-truncated into a
+    foreign shard's nibble."""
+    v = np.ones(4, np.float32)
+    frame = wire.encode(v, plane=wire.MAX_PLANE)  # boundary: fine
+    assert wire.frame_plane(frame) == wire.MAX_PLANE
+    with pytest.raises(ValueError, match="nibble"):
+        wire.encode(v, plane=wire.MAX_PLANE + 1)
+    with pytest.raises(ValueError, match="nibble"):
+        wire.encode(v, plane=-1)
+    with pytest.raises(TypeError):
+        wire.encode(v, plane=2.5)
+    with pytest.raises(TypeError):
+        wire.encode(v, plane=True)
+    assert wire.check_plane(np.int64(3)) == 3  # numpy ints are integral
+
+
+def test_decode_expect_plane_rejects_cross_shard_frames():
+    """DESIGN.md §19: a shard consumer decoding with ``expect_plane``
+    rejects a frame stamped for any other shard as a WireError — the
+    stamp is under the sender's CRC, so the mismatch is attributable
+    ban evidence, never a silent mis-fold."""
+    v = np.arange(8, dtype=np.float32)
+    f1 = wire.encode(v, plane=1)
+    np.testing.assert_array_equal(wire.decode(f1, expect_plane=1), v)
+    with pytest.raises(wire.WireError, match="cross-shard"):
+        wire.decode(f1, expect_plane=0)
+    # expect_plane itself is capacity-guarded.
+    with pytest.raises(ValueError):
+        wire.decode(f1, expect_plane=16)
+
+
 def test_wire_dtype_env(monkeypatch):
     monkeypatch.delenv("GARFIELD_WIRE_DTYPE", raising=False)
     assert wire.wire_dtype() == "f32"
