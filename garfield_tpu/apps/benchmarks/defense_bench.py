@@ -164,6 +164,15 @@ def run_cell(args, task, name, *, attack=None, attack_params=None,
                 "halflife": args.dp_halflife,
             }
 
+    # Wire-compression emulation (round 18): the adaptive-lie cells over
+    # a compressed gradient plane ARE the attack-headroom instrument —
+    # the controller's admitted magnitude under int8/int4/topk minus the
+    # bf16 baseline is the extra room quantization noise hands ALIE.
+    wire_kw = None
+    if getattr(args, "wire_dtype", "f32") != "f32" or \
+            getattr(args, "wire_topk", 0):
+        wire_kw = {"dtype": args.wire_dtype, "topk": args.wire_topk}
+
     def build(g, gp):
         return aggregathor.make_trainer(
             module, loss, opt, g,
@@ -172,6 +181,7 @@ def run_cell(args, task, name, *, attack=None, attack_params=None,
             gar_params=gp,
             telemetry=telemetry,
             defense=defense_kw,
+            wire=wire_kw,
         )
 
     t0 = time.time()
@@ -700,6 +710,16 @@ def main(argv=None):
                    help="Targeted-attack knobs for the grid's labelflip/"
                         "backdoor cells (source/target/poison_frac/"
                         "trigger_*).")
+    p.add_argument("--wire_dtype", type=str, default="f32",
+                   choices=("f32", "bf16", "int8", "int4"),
+                   help="In-graph wire-compression emulation for the "
+                        "gradient-plane cells (parallel/compress.py): "
+                        "the adaptive cells then measure the attack "
+                        "headroom the scheme hands the controller.")
+    p.add_argument("--wire_topk", type=int, default=0,
+                   help="Top-k sparsification divisor for the emulated "
+                        "wire (0 = off; nonzero replaces --wire_dtype "
+                        "on the gradient rows).")
     args = p.parse_args(argv)
 
     if args.grid:

@@ -42,6 +42,22 @@ cells included) carries ``peak_rss_bytes`` like HIERBENCH.
   python -m garfield_tpu.apps.benchmarks.exchange_bench \\
       --ns 4 --ds 100000 --wire f32 \\
       --scenario straggler churn partition --json EXCHBENCH_r02.json
+
+**--robust** (round 18, DESIGN.md §20): the EXCHBENCH_r05 matrix. Every
+``--wire`` payload scheme (now including int8/int4/topk) crossed with
+{static lie, adaptive lie} on the in-graph aggregathor emulation
+(pimanet/pima, n=16 f=3, vanilla krum) with the trainer's ``wire=``
+compressed gradient plane — the compression claim's robustness half:
+``matched_accuracy`` pins each cell within ``--acc_margin`` of the f32
+same-attack cell, and ``headroom`` records the adaptive controller's
+admitted magnitude minus the bf16 baseline's (the extra attack room the
+scheme's compression noise hands ALIE; negative results committed, not
+hidden). The micro cells at d=1e6 carry the matched byte half
+(``wire_bytes_per_step`` — the >=8x ratio):
+
+  python -m garfield_tpu.apps.benchmarks.exchange_bench \\
+      --ns 4 --ds 1000000 --wire f32 bf16 int8 int4 topk \\
+      --rounds 10 --trials 2 --robust --json EXCHBENCH_r05.json
 """
 
 import argparse
@@ -310,6 +326,99 @@ def bench_e2e(wire_dtype, n_w, iters, tmpdir):
                 / steps)
         ),
     }
+
+
+def bench_robust(args):
+    """The EXCHBENCH_r05 robustness matrix (round 18, DESIGN.md §20):
+    every payload scheme x {static lie, adaptive lie} on the in-graph
+    aggregathor emulation (pimanet/pima, n=16 f=3, vanilla krum —
+    defense_bench's cell harness with the trainer's ``wire=`` compressed
+    gradient plane). Two derived columns per row:
+
+    - ``matched_accuracy``: the cell's accuracy within ``--acc_margin``
+      of the f32 scheme's SAME-attack cell — the "compression must not
+      open a Byzantine loophole" acceptance bit.
+    - ``headroom`` (adaptive cells): the bisection controller's admitted
+      magnitude minus the bf16 baseline's — the extra attack room the
+      scheme's compression noise hands ALIE. Recorded even when it is
+      a negative result (a scheme buying robustness, or noise burying
+      the static z).
+
+    jax imports live inside this function: the micro/scenario paths and
+    their children stay jax-free.
+    """
+    from types import SimpleNamespace
+
+    import jax
+
+    from ...attacks import LIE_Z
+    from ...parallel import core as pcore
+    from . import defense_bench as db
+
+    dargs = SimpleNamespace(
+        num_iter=args.robust_iters, batch=8, lr=0.1, margin=1.2,
+        seed=args.robust_seed, halflife=24.0,
+        theta_up=0.35, theta_down=0.1, patience=4, clean_window=60,
+        wire_dtype="f32", wire_topk=0,
+    )
+    task = db._task(dargs)
+    module, loss, _, xs, _, _ = task
+    init_worker, _, _ = pcore.make_worker_fns(module, loss)
+    params, _ = init_worker(jax.random.PRNGKey(0), xs[0, 0])
+    d_flat = sum(int(l.size) for l in jax.tree.leaves(params))
+
+    def scheme_nbytes(scheme):
+        if scheme == "topk":
+            k = wire.topk_k(d_flat, wire.DEFAULT_TOPK_DIV)
+            return wire.frame_nbytes(d_flat, "topk", k=k)
+        return wire.frame_nbytes(d_flat, scheme)
+
+    schemes = [w for w in wire.WIRE_SCHEMES if w in args.wire]
+    for need in ("f32", "bf16"):
+        # The two baselines the derived columns divide by.
+        if need not in schemes:
+            schemes.insert(0, need)
+    rows, cells = [], {}
+    for scheme in schemes:
+        dargs.wire_dtype = "f32" if scheme == "topk" else scheme
+        dargs.wire_topk = wire.DEFAULT_TOPK_DIV if scheme == "topk" else 0
+        for attack, params_a, label in (
+            ("lie", {"z": LIE_Z}, "lie"),
+            ("adaptive-lie", {"mag_max": 6.0}, "adaptive_lie"),
+        ):
+            rec = db.run_cell(
+                dargs, task, f"{scheme}/{label}",
+                attack=attack, attack_params=params_a, gar="krum",
+            )
+            cells[(scheme, label)] = rec
+            ratio = scheme_nbytes("f32") / scheme_nbytes(scheme)
+            rows.append({
+                "mode": "robust", "n": db.N_WORKERS, "d": d_flat,
+                "wire": scheme, "cell": f"{scheme}/{label}",
+                "attack": attack, "gar": "krum",
+                "rounds": int(dargs.num_iter),
+                "final_accuracy": rec["final_accuracy"],
+                "attack_magnitude": rec["attack_magnitude"],
+                "wire_bytes_per_step":
+                    (db.N_WORKERS - 1) * scheme_nbytes(scheme),
+                "compression_ratio": round(ratio, 3),
+                "headroom": None, "matched_accuracy": None,
+                "peak_rss_bytes": peak_rss_bytes(),
+            })
+    for row in rows:
+        scheme = row["wire"]
+        label = "adaptive_lie" if row["cell"].endswith("adaptive_lie") \
+            else "lie"
+        base = cells[("f32", label)]["final_accuracy"]
+        row["matched_accuracy"] = bool(
+            abs(row["final_accuracy"] - base) <= args.acc_margin
+        )
+        if label == "adaptive_lie":
+            bf16_mag = cells[("bf16", "adaptive_lie")]["attack_magnitude"]
+            mag = row["attack_magnitude"]
+            if bf16_mag is not None and mag is not None:
+                row["headroom"] = round(mag - bf16_mag, 6)
+    return rows
 
 
 def _spawn_follow(k, hosts, d, wire_dtype, delay_ms=0, spike_round=0,
@@ -1137,7 +1246,7 @@ def main(argv=None):
     p.add_argument("--ds", nargs="*", type=int,
                    default=[1_000, 100_000, 1_000_000])
     p.add_argument("--wire", nargs="*", default=list(wire.WIRE_DTYPES),
-                   choices=wire.WIRE_DTYPES)
+                   choices=wire.WIRE_SCHEMES)
     p.add_argument("--rounds", type=int, default=20,
                    help="publish/collect rounds per trial")
     p.add_argument("--trials", type=int, default=3,
@@ -1197,6 +1306,21 @@ def main(argv=None):
     p.add_argument("--decay", type=float, default=0.9,
                    help="per-round staleness discount for the scenario "
                         "gathers")
+    p.add_argument("--robust", action="store_true",
+                   help="run the EXCHBENCH_r05 robustness matrix: every "
+                        "--wire scheme x {lie, adaptive-lie} on the "
+                        "in-graph aggregathor emulation (pimanet/pima, "
+                        "n=16 f=3 krum) over a compressed gradient "
+                        "plane — matched-accuracy + adaptive-attack-"
+                        "headroom columns per cell (DESIGN.md §20). "
+                        "Needs jax (CPU is fine); the only mode here "
+                        "that does")
+    p.add_argument("--robust_iters", type=int, default=240,
+                   help="training steps per robustness cell")
+    p.add_argument("--robust_seed", type=int, default=1234)
+    p.add_argument("--acc_margin", type=float, default=0.05,
+                   help="matched-accuracy tolerance vs the f32 "
+                        "same-attack cell")
     p.add_argument("--json", type=str, default=None,
                    help="dump results (+ the schema-versioned telemetry "
                         "JSONL twin at the same path with a .jsonl "
@@ -1312,6 +1436,18 @@ def main(argv=None):
                             f"({row['spans']} spans)",
                             flush=True,
                         )
+    if args.robust:
+        for row in bench_robust(args):
+            results.append(row)
+            print(
+                f"robust cell={row['cell']:<18} "
+                f"acc={row['final_accuracy']:.4f} "
+                f"mag={row['attack_magnitude']} "
+                f"headroom={row['headroom']} "
+                f"ratio={row['compression_ratio']}x "
+                f"matched={row['matched_accuracy']}",
+                flush=True,
+            )
     if args.e2e:
         import tempfile
 
@@ -1384,6 +1520,21 @@ def main(argv=None):
                         speedup=row.get("speedup"),
                         learn_ms0_bitwise=row.get("learn_ms0_bitwise"),
                         suspicion=row.get("suspicion"),
+                        rounds=row["rounds"],
+                        peak_rss_bytes=row["peak_rss_bytes"],
+                    ))
+                elif row["mode"] == "robust":
+                    exp.write(exporters.make_record(
+                        "exchange_bench",
+                        n=row["n"], d=row["d"], wire=row["wire"],
+                        cell=row["cell"], attack=row["attack"],
+                        gar=row["gar"],
+                        final_accuracy=row["final_accuracy"],
+                        attack_magnitude=row["attack_magnitude"],
+                        headroom=row["headroom"],
+                        compression_ratio=row["compression_ratio"],
+                        matched_accuracy=row["matched_accuracy"],
+                        wire_bytes_per_step=row["wire_bytes_per_step"],
                         rounds=row["rounds"],
                         peak_rss_bytes=row["peak_rss_bytes"],
                     ))

@@ -489,22 +489,34 @@ class WireStats:
     (docs/TELEMETRY.md): bytes and codec seconds, both directions,
     broken down PER PLANE (schema v6 — the ``planes`` sub-object of the
     per-step ``wire`` event feeds the plane-labelled Prometheus byte
-    counters). Receive-side appends happen on exchange waiter threads —
-    ``list.append`` is GIL-atomic; the sums happen at the per-step
-    ``flush`` on the role's main thread."""
+    counters) and PER SCHEME (schema v11 — the ``schemes`` sub-object
+    plus the ``compression_ratio`` / ``ef_residual_norm`` fields behind
+    the round-18 compressed wire). Receive-side appends happen on
+    exchange waiter threads — ``list.append`` is GIL-atomic; the sums
+    happen at the per-step ``flush`` on the role's main thread."""
 
     def __init__(self, who):
         self.who = who
         self._out = []
         self._in = []
+        # Set by roles that run error feedback (the gradient-plane
+        # senders) so flush can surface the residual norm per step.
+        self.ef = None
 
-    def sent(self, nbytes, encode_s, fanout, plane=0):
+    def sent(self, nbytes, encode_s, fanout, plane=0, scheme="f32",
+             elems=0):
+        # f32-equivalent bytes ride along so flush can report the
+        # compression ratio without re-deriving frame geometry.
+        f32_eq = (wire.HEADER_NBYTES + 4 * int(elems)) * int(fanout)
         self._out.append(
-            (int(nbytes) * int(fanout), float(encode_s), int(plane))
+            (int(nbytes) * int(fanout), float(encode_s), int(plane),
+             str(scheme), f32_eq)
         )
 
-    def received(self, nbytes, decode_s, plane=0):
-        self._in.append((int(nbytes), float(decode_s), int(plane)))
+    def received(self, nbytes, decode_s, plane=0, scheme="f32"):
+        self._in.append(
+            (int(nbytes), float(decode_s), int(plane), str(scheme))
+        )
 
     def flush(self, step):
         out, self._out = self._out, []
@@ -512,38 +524,119 @@ class WireStats:
         if tele_hooks.current() is None:
             return
         planes = {}
-        for b, _, p in out:
+        schemes = {}
+        for b, _, p, s, _ in out:
             planes.setdefault(p, [0, 0])[0] += b
-        for b, _, p in rin:
+            schemes.setdefault(s, [0, 0])[0] += b
+        for b, _, p, s in rin:
             planes.setdefault(p, [0, 0])[1] += b
+            schemes.setdefault(s, [0, 0])[1] += b
+        bytes_out = sum(b for b, _, _, _, _ in out)
+        f32_eq_out = sum(e for _, _, _, _, e in out)
+        extra = {}
+        if bytes_out and f32_eq_out != bytes_out:
+            # The per-step send-side ratio vs an f32 wire — the ≥8x
+            # claim's live counterpart (schema v11).
+            extra["compression_ratio"] = round(f32_eq_out / bytes_out, 3)
+        if self.ef is not None:
+            extra["ef_residual_norm"] = round(self.ef.total_norm(), 6)
         tele_hooks.emit_event(
             "wire", who=self.who, step=int(step),
-            bytes_out=sum(b for b, _, _ in out),
-            bytes_in=sum(b for b, _, _ in rin),
+            bytes_out=bytes_out,
+            bytes_in=sum(b for b, _, _, _ in rin),
             frames_in=len(rin),
-            encode_s=round(sum(t for _, t, _ in out), 6),
-            decode_s=round(sum(t for _, t, _ in rin), 6),
+            encode_s=round(sum(t for _, t, _, _, _ in out), 6),
+            decode_s=round(sum(t for _, t, _, _ in rin), 6),
             planes={
                 str(p): {"bytes_out": bo, "bytes_in": bi}
                 for p, (bo, bi) in sorted(planes.items())
             },
+            schemes={
+                s: {"bytes_out": bo, "bytes_in": bi}
+                for s, (bo, bi) in sorted(schemes.items())
+            },
+            **extra,
         )
 
 
-def _encode_frame(parts, stats=None, fanout=1, plane=0):
+# The schemes whose compression error is biased (and therefore needs the
+# error-feedback accumulator): everything lossy except bf16, which stays
+# EF-free like the PR 4 wire so its frames remain byte-identical.
+_EF_SCHEMES = ("int8", "int4", "topk")
+
+
+def _wire_scheme(plane):
+    """Resolve the send scheme for ``plane`` (round 18, DESIGN.md §20).
+
+    The ``GARFIELD_WIRE_TOPK`` sparsification overlay applies to the
+    GRADIENT plane only: model/gossip broadcasts are absolute state —
+    a sparse model frame read by a catching-up peer (read_latest,
+    last-writer-wins) would zero every coordinate outside this round's
+    top-k — so they keep the dense ``GARFIELD_WIRE_DTYPE`` width. The
+    control plane (plane 0 sentinels) is dense for the same reason."""
+    if plane == PLANE_GRAD and wire.wire_topk() > 0:
+        return "topk"
+    return wire.wire_dtype()
+
+
+def _maybe_error_feedback(who, wire_stats):
+    """This role's gradient-plane error-feedback accumulator, when the
+    resolved gradient scheme is biased-lossy (``_EF_SCHEMES``); None
+    otherwise. HOST RESTART SEMANTICS (the documented contract —
+    tests/test_compress.py pins the in-graph half): the accumulator is
+    rebuilt AT ZERO here, because the residual is a bounded one-step
+    correction (||e|| <= one step's compression error) — a restart
+    costs one step of compensation, not convergence — and the rebuild
+    is ANNOUNCED so a restarted run's log shows the reset instead of a
+    silent zeroing. Bitwise-reproducible resume is the in-graph twin's
+    job (TrainState.wire_state rides the checkpoint tree)."""
+    scheme = _wire_scheme(PLANE_GRAD)
+    if scheme not in _EF_SCHEMES:
+        return None
+    ef = wire.ErrorFeedback()
+    wire_stats.ef = ef
+    tools.info(
+        f"[{who}] wire scheme {scheme!r}: error-feedback accumulator "
+        "rebuilt at zero (a host restart drops at most one step of "
+        "compensation; bitwise resume lives on the in-graph twin)"
+    )
+    return ef
+
+
+def _encode_frame(parts, stats=None, fanout=1, plane=0, ef=None):
     """The wire codec's single PRODUCER for the cluster driver: encode
     the concatenation of f32 segments (``[grad || stats]`` /
-    ``[params || stats]``) as one typed frame at the configured
-    ``GARFIELD_WIRE_DTYPE``, accounting bytes x fan-out and encode time
-    for the telemetry plane. ``plane`` stamps the codec header's plane
-    tag (PLANE_GRAD/PLANE_MODEL) — the self-describing half of the
-    per-plane accounting."""
+    ``[params || stats]``) as one typed frame at the plane's resolved
+    scheme (``_wire_scheme``), accounting bytes x fan-out and encode
+    time for the telemetry plane. ``plane`` stamps the codec header's
+    plane tag (PLANE_GRAD/PLANE_MODEL) — the self-describing half of
+    the per-plane accounting.
+
+    With multiple parts the FIRST part is the additive head (gradient /
+    params) and the rest the BatchNorm-stats tail: top-k keeps the tail
+    dense (``keep_from`` — robust-stats input, not a sparse signal) and
+    error feedback compensates the head only. ``ef`` (a
+    ``wire.ErrorFeedback``, keyed per plane — frames broadcast
+    byte-identical to all peers, so per sender x plane is full
+    resolution) makes this sender transmit C(g + e) and carry
+    e' = (g + e) - decode(C(g + e)); the residual uses the receiver's
+    OWN decode of the frame actually shipped, so it is exactly the
+    error every peer saw."""
     t0 = time.perf_counter()
     parts = [np.asarray(p, np.float32).reshape(-1) for p in parts]
     vec = parts[0] if len(parts) == 1 else np.concatenate(parts)
-    frame = wire.encode(vec, plane=plane)
+    scheme = _wire_scheme(plane)
+    keep_from = parts[0].size if len(parts) > 1 else None
+    if ef is not None and scheme in _EF_SCHEMES:
+        upto = vec.size if keep_from is None else keep_from
+        vec = ef.compensate(plane, vec, upto=upto)
+        frame = wire.encode(vec, scheme, plane=plane, keep_from=keep_from)
+        ef.update(plane, vec, wire.decode(frame), upto=upto)
+    else:
+        frame = wire.encode(vec, scheme, plane=plane, keep_from=keep_from)
     if stats is not None:
-        stats.sent(len(frame), time.perf_counter() - t0, fanout, plane)
+        stats.sent(len(frame), time.perf_counter() - t0, fanout, plane,
+                   scheme=scheme, elems=vec.size)
     return frame
 
 
@@ -567,7 +660,11 @@ def _frame_transform(split, stats=None, pass_empty=False, plane=0):
             return payload
         t0 = time.perf_counter()
         try:
-            vec = wire.decode(payload)
+            # expect_elems pins the header's dense size BEFORE the
+            # scatter allocation: a sparse frame's elems is otherwise a
+            # bare claim (see wire.decode) — the consumer's d is the
+            # ground truth here.
+            vec = wire.decode(payload, expect_elems=d0 + d1)
             if vec.size != d0 + d1:
                 raise wire.WireError(
                     f"frame has {vec.size} elements, expected {d0 + d1}"
@@ -582,7 +679,10 @@ def _frame_transform(split, stats=None, pass_empty=False, plane=0):
             except Exception:  # noqa: BLE001 — host row still works
                 pass  # jnp.stack uploads at harvest instead
         if stats is not None:
-            stats.received(len(payload), time.perf_counter() - t0, plane)
+            stats.received(
+                len(payload), time.perf_counter() - t0, plane,
+                scheme=wire.frame_scheme(payload),
+            )
         return head, tail
 
     return transform
@@ -2731,6 +2831,7 @@ def _run_learn(args):
     # gradients, the gossip plane [params || stats] — both through the
     # typed codec, decoded eagerly by the pre-registered waiters.
     wire_stats = WireStats(who)
+    grad_ef = _maybe_error_feedback(who, wire_stats)
     grad_split = (flat.size, 0)
     gossip_split = (flat.size, bn_elems)
     grad_tf = _frame_transform(grad_split, wire_stats, plane=PLANE_GRAD)
@@ -2969,7 +3070,7 @@ def _run_learn(args):
                 ex.publish(
                     i,
                     _encode_frame([g], wire_stats, fanout=n - 1,
-                                  plane=PLANE_GRAD),
+                                  plane=PLANE_GRAD, ef=grad_ef),
                     plane=PLANE_GRAD,
                 )
                 try:
@@ -3123,7 +3224,7 @@ def _run_learn(args):
             ex.publish(
                 2 * i + 2,
                 _encode_frame([g], wire_stats, fanout=n - 1,
-                              plane=PLANE_GRAD),
+                              plane=PLANE_GRAD, ef=grad_ef),
             )
             try:
                 with tele_trace.span("quorum", step=i, plane="grad"):
@@ -3436,6 +3537,7 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
     if atk_kind == "targeted":
         targeted_cfg = _targeted_config(args, who)
     wire_stats = WireStats(who)
+    grad_ef = _maybe_error_feedback(who, wire_stats)
     split = (flat_np.size, bn_elems)
     # pass_empty: the PS's stop sentinel is an empty frame, not a codec
     # frame — it must reach the loop's sentinel check undecoded.
@@ -3571,7 +3673,7 @@ def _run_worker(args, windex, ps_ranks, my_xs, my_ys, grad_fn, ms0, flat0,
         ex.publish(
             step,
             _encode_frame(out_parts, wire_stats, fanout=len(targets),
-                          plane=PLANE_GRAD),
+                          plane=PLANE_GRAD, ef=grad_ef),
             to=targets,
         )
 

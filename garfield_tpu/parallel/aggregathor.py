@@ -150,6 +150,7 @@ def make_trainer(
     telemetry=False,
     staleness=None,
     defense=None,
+    wire=None,
 ):
     """Build ``(init_fn, step_fn, eval_fn)`` for the SSMW topology.
 
@@ -258,6 +259,26 @@ def make_trainer(
     the negative result §18 records). Per-step scores/flags/weights
     surface as ``dataplane_*`` metrics (schema-v9 ``data_defense``
     events in the app loop).
+
+    ``wire`` is the in-graph EMULATION of the host wire codec's lossy
+    schemes (parallel/compress.py, DESIGN.md §20): a dict with ``dtype``
+    (one of ``wire.WIRE_DTYPES``), ``topk`` (sparsification divisor, 0 =
+    off; nonzero replaces the dense scheme on the gradient rows, the
+    cluster's gradient-plane policy) and ``error_feedback`` (default
+    True; effective for the lossy int8/int4/topk schemes only — bf16
+    stays EF-free like the PR 4 wire, f32 is lossless). The round trip
+    is applied to the gathered rows AFTER the worker-momentum update
+    (momentum accumulates the uncompressed honest signal, exactly like
+    a host worker's local state) and BEFORE the attack (a Byzantine
+    process controls its wire bytes — compression constrains honest
+    senders only). The EF residual rows ride
+    ``TrainState.wire_state["resid"]`` through the chunk-scan carry and
+    the checkpoint tree, so chunked and resumed compressed runs are
+    bitwise (tests/test_compress.py). ``wire=None`` or
+    ``{"dtype": "f32", "topk": 0}`` traces NOTHING — trajectories are
+    bitwise the uncompressed ones. The quantizer grid is pinned
+    bit-identical to the host codec (``utils/wire.py``), so what the
+    matrix measures here is what compressed frames do to the GARs.
 
     ``step_fn(state, x, y) -> (state, metrics)`` expects ``x``/``y`` with a
     leading ``num_workers`` axis, sharded over ``axis``; it is jit'd with
@@ -481,6 +502,38 @@ def make_trainer(
         # same Gram-only fold constraint, same where-path fallback.
         fold_plan = None
 
+    # Wire-compression emulation (see docstring): resolve the scheme at
+    # build time so the no-compression configs trace NOTHING — the
+    # bitwise contract every other optional feature here honors.
+    wire_scheme = wire_div = None
+    wire_ef = False
+    if wire is not None:
+        from ..utils import wire as wire_lib
+        from . import compress as compress_lib
+
+        wc = dict(wire)
+        w_dtype = str(wc.pop("dtype", "f32")).lower()
+        w_topk = int(wc.pop("topk", 0))
+        w_ef = bool(wc.pop("error_feedback", True))
+        if wc:
+            raise ValueError(f"unknown wire keys {sorted(wc)}")
+        if w_dtype not in wire_lib.WIRE_DTYPES:
+            raise ValueError(
+                f"wire dtype must be one of {wire_lib.WIRE_DTYPES}, "
+                f"got {w_dtype!r}"
+            )
+        if w_topk < 0:
+            raise ValueError(
+                f"wire topk divisor must be >= 0 (0 = off), got {w_topk}"
+            )
+        if w_topk > 0:
+            wire_scheme, wire_div = "topk", w_topk
+        elif w_dtype != "f32":
+            wire_scheme = w_dtype
+        # EF is only sound (and only needed) for the biased lossy
+        # schemes; bf16 stays EF-free like the PR 4 host wire.
+        wire_ef = w_ef and wire_scheme in ("int8", "int4", "topk")
+
     init_worker, grad_fn, eval_apply = core.make_worker_fns(module, loss_fn)
     # Slot-fused gradient twin (models/slotfused.py) when eligible, else
     # run-length-aware unroll/vmap (core.select_slot_path).
@@ -527,6 +580,14 @@ def make_trainer(
                     "dp_obs": jnp.zeros((num_workers,), jnp.float32),
                     "dp_exc": jnp.zeros((num_workers,), jnp.float32),
                 })
+        wire_state = None
+        if wire_ef:
+            # Zero EF residuals — checkpointed with the rest of the
+            # state tree, so a resumed run carries them bitwise.
+            d_flat = sum(
+                int(l.size) for l in jax.tree.leaves(params)
+            )
+            wire_state = compress_lib.init_wire_state(num_workers, d_flat)
         state = core.TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -537,6 +598,7 @@ def make_trainer(
             gar_state=gar_state,
             attack_state=attack_state,
             defense_state=defense_state,
+            wire_state=wire_state,
         )
         return jax.device_put(state, repl)
 
@@ -612,6 +674,34 @@ def make_trainer(
                 worker_momentum, state.worker_mom, grads
             )
             new_mom = grads
+
+        # Wire-compression emulation (see docstring): encode->decode the
+        # rows every honest worker would put on the wire. AFTER momentum
+        # (the EMA is worker-local host state, accumulated uncompressed),
+        # BEFORE the attack (a Byzantine sender controls its bytes — the
+        # attack overwrites its rows downstream, exactly as on the
+        # cluster). The GARs then consume dense f32-dequantized rows, so
+        # fold/row-weight algebra is untouched by construction.
+        new_wire = state.wire_state
+        if wire_scheme is not None:
+            flat_w = core.flatten_rows(grads).astype(jnp.float32)
+            w_k = (
+                wire_lib.topk_k(flat_w.shape[1], wire_div)
+                if wire_scheme == "topk" else None
+            )
+            if wire_ef:
+                sent_w, resid_w = compress_lib.ef_roundtrip_rows(
+                    flat_w, state.wire_state["resid"], wire_scheme, k=w_k
+                )
+                new_wire = {"resid": resid_w}
+            else:
+                sent_w = compress_lib.roundtrip_rows(
+                    flat_w, wire_scheme, k=w_k
+                )
+            grads = jax.vmap(
+                lambda r: core.unflatten_like(params, r)
+            )(sent_w)
+            grads = core.cast_leaves(grads, gar_dtype)
 
         honest = (~byz_mask).astype(losses.dtype)
         mean_loss = jnp.sum(losses * honest) / jnp.sum(honest)
@@ -959,8 +1049,15 @@ def make_trainer(
             gar_state=new_gar_state,
             attack_state=new_attack_state,
             defense_state=new_defense_state,
+            wire_state=new_wire,
         )
         metrics = {"loss": mean_loss}
+        if wire_ef:
+            # Per-rank EF residual L2 norms — the in-graph twin of the
+            # wire event's ef_residual_norm field (schema v11).
+            metrics["wire_resid_norm"] = jnp.sqrt(
+                jnp.sum(new_wire["resid"] ** 2, axis=1)
+            )
         if adaptive_cfg is not None:
             # Controller observability (the app loop surfaces these as
             # schema-v7 ``attack_adapt`` events): the magnitude actually

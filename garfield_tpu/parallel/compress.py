@@ -1,0 +1,116 @@
+"""In-graph twin of the wire codec's lossy schemes (DESIGN.md §20).
+
+The cluster's compression happens on the host in ``utils/wire.py``; the
+single-process trainers (``parallel/aggregathor``) emulate it INSIDE the
+jitted step so the robustness matrix, DEFBENCH's adaptive-lie controller
+and the convergence tests measure what compressed frames do to the GARs
+without standing up a TCP cluster. Everything here is pure jnp — it
+rides ``shard_map``/``lax.scan`` and differentiably does nothing (the
+round trip sits on the data path after ``stop_gradient``-equivalent
+gradient extraction).
+
+What IS pinned against the host codec: the grid. ``_quant_rows`` uses
+the same symmetric per-block scale (``max|x| / qmax``), the same
+round-to-nearest-even, the same clip — so a quantized value here equals
+``wire.decode(wire.encode(...))`` of the same f32 input bit-for-bit.
+
+What is NOT pinned: top-k tie-breaking. ``jax.lax.top_k`` and
+``np.argpartition`` may keep different coordinates when |values| tie at
+the k-th magnitude; the emulation keeps AT LEAST k coordinates (every
+coordinate >= the k-th magnitude survives the threshold mask). Ties are
+measure-zero for real gradients; the host<->graph parity tests pin the
+quantizers bitwise and the sparsifier on tie-free inputs only.
+
+Error feedback (Karimireddy et al., EF-SGD) lives in
+``TrainState.wire_state["resid"]`` — an (n_workers, d) f32 residual
+carried through the scan chunk carry and the checkpoint tree, which is
+what makes chunked and mid-run-resumed trainings bitwise-identical to
+straight runs (tests/test_compress.py pins both). The residual
+compensates the HEAD (gradient) segment only; see
+``wire.ErrorFeedback`` for why model/gossip planes never get EF.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import wire
+
+__all__ = ["roundtrip_rows", "ef_roundtrip_rows", "init_wire_state"]
+
+
+def _quant_rows(rows, qmax, block):
+    """Per-block symmetric linear quantize + dequantize of (n, d) rows.
+
+    Bit-identical twin of the host ``wire._quant_payload`` ->
+    ``wire._dequant`` round trip: pad each row to a block multiple,
+    scale = max|x| / qmax per block, round-to-nearest-even
+    (``jnp.rint`` == ``np.rint``), clip to [-qmax, qmax], multiply back.
+    """
+    n, d = rows.shape
+    nblocks = -(-d // block)
+    pad = nblocks * block - d
+    x = jnp.pad(rows, ((0, 0), (0, pad))) if pad else rows
+    xb = x.reshape(n, nblocks, block)
+    scales = jnp.max(jnp.abs(xb), axis=2) / jnp.float32(qmax)
+    safe = jnp.where(scales > 0, scales, jnp.float32(1.0))
+    codes = jnp.clip(jnp.rint(xb / safe[:, :, None]), -qmax, qmax)
+    out = (codes * scales[:, :, None]).reshape(n, nblocks * block)
+    return out[:, :d].astype(jnp.float32)
+
+
+def _topk_rows(rows, k):
+    """Magnitude top-k mask of (n, d) rows via the k-th-magnitude
+    threshold (``lax.top_k`` on |rows|). Keeps every coordinate whose
+    magnitude >= the k-th largest — i.e. AT LEAST k survive on ties
+    (see the module docstring for why that is acceptable drift from the
+    host's exactly-k frames)."""
+    d = rows.shape[-1]
+    k = int(min(max(k, 1), d))
+    kth = jax.lax.top_k(jnp.abs(rows), k)[0][:, -1]
+    mask = jnp.abs(rows) >= kth[:, None]
+    return jnp.where(mask, rows, jnp.float32(0.0))
+
+
+def roundtrip_rows(rows, scheme, *, k=None, block=wire.QUANT_BLOCK):
+    """Encode->decode emulation of one wire scheme over (n, d) f32 rows.
+
+    ``scheme`` in ``wire.WIRE_SCHEMES``; "f32" is the identity, "bf16"
+    the XLA convert round trip (same RNE the host codec uses), "topk"
+    needs ``k`` (kept coordinates per row)."""
+    rows = rows.astype(jnp.float32)
+    if scheme == "f32":
+        return rows
+    if scheme == "bf16":
+        return rows.astype(jnp.bfloat16).astype(jnp.float32)
+    if scheme == "int8":
+        return _quant_rows(rows, 127, int(block))
+    if scheme == "int4":
+        return _quant_rows(rows, 7, int(block))
+    if scheme == "topk":
+        if k is None:
+            raise ValueError("topk roundtrip needs an explicit k")
+        return _topk_rows(rows, k)
+    raise ValueError(f"unknown wire scheme {scheme!r}")
+
+
+def init_wire_state(num_workers, d):
+    """Fresh error-feedback state for ``TrainState.wire_state``: one
+    zero residual row per worker slot. Checkpointed with the rest of
+    the state tree, so resume carries non-zero residuals bitwise."""
+    return {"resid": jnp.zeros((int(num_workers), int(d)), jnp.float32)}
+
+
+def ef_roundtrip_rows(rows, resid, scheme, *, k=None,
+                      block=wire.QUANT_BLOCK):
+    """Error-feedback compressed emulation of the gradient plane.
+
+    Sends ``C(rows + resid)`` and returns ``(sent, new_resid)`` with
+    ``new_resid = (rows + resid) - sent`` — the in-graph twin of
+    ``wire.ErrorFeedback.compensate``/``update`` around the host
+    encode/decode. The caller decides WHICH rows are honest senders;
+    Byzantine rows overwrite ``sent`` afterwards (an attacker controls
+    its wire bytes), and their residual rows are dead state.
+    """
+    comp = rows.astype(jnp.float32) + resid
+    sent = roundtrip_rows(comp, scheme, k=k, block=block)
+    return sent, comp - sent

@@ -79,6 +79,13 @@ class TrainState:
     # derive from — the on-mesh emulation of the host MetricsHub's
     # decayed suspicion. None when the defense is off.
     defense_state: object = None
+    # Wire-compression emulation state (parallel/compress.py, DESIGN.md
+    # §20): the per-worker error-feedback residual rows
+    # {"resid": (n_workers, d) f32} when a lossy scheme runs with EF.
+    # Riding in the TrainState is what makes chunked and mid-run-resumed
+    # compressed trainings bitwise (scan carry + checkpoint tree). None
+    # when compression is off or EF-free.
+    wire_state: object = None
 
 
 def make_worker_fns(module, loss_fn):

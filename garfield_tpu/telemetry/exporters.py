@@ -114,7 +114,19 @@ SCHEMA = "garfield-telemetry"
 # ``garfield_client_suspicion_decayed`` Prometheus series, and the new
 # ``fed_bench`` kind (FEDBENCH_r*'s rows: the 1/S shard-scaling cells,
 # the S=1 bitwise anchor, the autoscaled fleet-rate cells).
-SCHEMA_VERSION = 10
+# v11 (round 18, the compressed wire — DESIGN.md §20): the ``wire``
+# EVENT gained the per-SCHEME byte breakdown (``schemes`` sub-object:
+# f32/bf16/int8/int4/topk, each {bytes_out, bytes_in}) plus the
+# optional ``compression_ratio`` (send-side f32-equivalent bytes /
+# actual bytes this step) and ``ef_residual_norm`` (the gradient-plane
+# error-feedback accumulator's L2 norm) fields — all validated below —
+# ``summary`` gained the optional ``wire_schemes`` digest, the
+# ``garfield_wire_bytes_total{scheme=}`` Prometheus counters landed
+# beside the direction-only totals, and ``exchange_bench`` rows may
+# carry the EXCHBENCH_r05 robustness-cell fields (``cell``,
+# ``final_accuracy``, ``attack_magnitude``, ``headroom``,
+# ``compression_ratio``, ``matched_accuracy``).
+SCHEMA_VERSION = 11
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
          "transfer_bench", "exchange_bench", "hier_bench", "span",
@@ -407,6 +419,42 @@ def validate_record(rec):
                     f"cohort.f_budget must be a non-negative int or "
                     f"null, got {fb!r}"
                 )
+        elif rec.get("event") == "wire":
+            # v11: the per-step wire digest (apps/cluster.WireStats) —
+            # byte totals, the per-plane/per-scheme breakdowns, and the
+            # compressed-wire extras (DESIGN.md §20): the live
+            # compression ratio vs an f32 wire and the error-feedback
+            # residual norm.
+            for key in ("bytes_out", "bytes_in", "frames_in"):
+                val = rec.get(key)
+                if val is not None and (
+                    not isinstance(val, int) or isinstance(val, bool)
+                    or val < 0
+                ):
+                    _fail(
+                        f"wire.{key} must be a non-negative int or "
+                        f"null, got {val!r}"
+                    )
+            for key in ("encode_s", "decode_s", "compression_ratio",
+                        "ef_residual_norm"):
+                val = rec.get(key)
+                if val is not None and not _is_num(val):
+                    _fail(
+                        f"wire.{key} must be a number or null, got {val!r}"
+                    )
+            for key in ("planes", "schemes"):
+                d = rec.get(key)
+                if d is not None:
+                    if not isinstance(d, dict) or not all(
+                        isinstance(v, dict) and all(
+                            _is_num(x) for x in v.values()
+                        )
+                        for v in d.values()
+                    ):
+                        _fail(
+                            f"wire.{key} must map names to numeric byte "
+                            f"objects, got {d!r}"
+                        )
         elif rec.get("event") == "autoscale":
             # v6: one elastic-membership action (DESIGN.md §15).
             if rec.get("action") not in ("spawn", "retire"):
@@ -538,6 +586,21 @@ def validate_record(rec):
                     _fail(
                         f"summary.targeted.{key} must be a number or "
                         f"null, got {val!r}"
+                    )
+        for key in ("wire_planes", "wire_schemes"):
+            # v6 planes / v11 schemes: the hub's cumulative wire byte
+            # breakdowns ({name: {bytes_out, bytes_in}}).
+            d = rec.get(key)
+            if d is not None:
+                if not isinstance(d, dict) or not all(
+                    isinstance(v, dict) and all(
+                        _is_num(x) for x in v.values()
+                    )
+                    for v in d.values()
+                ):
+                    _fail(
+                        f"summary.{key} must map names to numeric byte "
+                        f"objects, got {d!r}"
                     )
         st = rec.get("step_time")
         if st is not None:
@@ -800,12 +863,31 @@ def validate_record(rec):
                     f"exchange_bench.phases must map phases to numeric "
                     f"stat objects, got {phases!r}"
                 )
+        cell = rec.get("cell")
+        if cell is not None and not isinstance(cell, str):
+            # v11: EXCHBENCH_r05 robustness-matrix cells carry a cell
+            # label (scheme x attack) like the DEFBENCH rows do.
+            _fail(
+                f"exchange_bench.cell must be a string or null, got "
+                f"{cell!r}"
+            )
+        ma = rec.get("matched_accuracy")
+        if ma is not None and not isinstance(ma, bool):
+            _fail(
+                f"exchange_bench.matched_accuracy must be a bool or "
+                f"null, got {ma!r}"
+            )
         for key in ("round_s", "wire_bytes_per_step", "straggler_ms",
                     "sync_round_s", "async_round_s", "speedup",
                     "trace_off_round_s", "trace_on_round_s",
                     "trace_overhead",
                     # v6: autoscale scenario rates (scaleup/scaledown).
-                    "pre_rate", "spike_rate", "recovered_rate"):
+                    "pre_rate", "spike_rate", "recovered_rate",
+                    # v11: the compressed-wire robustness cells
+                    # (EXCHBENCH_r05) — matched-accuracy check plus the
+                    # adaptive-attack headroom instrument.
+                    "final_accuracy", "attack_magnitude", "headroom",
+                    "compression_ratio"):
             val = rec.get(key)
             if val is not None and not _is_num(val):
                 _fail(
@@ -942,10 +1024,23 @@ def prometheus_text(hub):
             )
     w = hub.wire_counters()
     if any(w.values()):
+        # v11: the scheme-labelled samples (DESIGN.md §20) join the
+        # direction-only totals under the same counter — the
+        # compressed-wire claim (≥8x bytes/step) auditable live. Sum
+        # over {direction=} alone; the {scheme=,direction=} series are
+        # the breakdown, not additional traffic.
+        wire_samples = [({"direction": "out"}, float(w["bytes_out"])),
+                        ({"direction": "in"}, float(w["bytes_in"]))]
+        wire_samples += [
+            ({"scheme": s, "direction": d}, float(counts["bytes_" + d]))
+            for s, counts in hub.wire_scheme_counters().items()
+            for d in ("out", "in")
+        ]
         metric("garfield_wire_bytes_total", "counter",
-               "Wire bytes through the typed host-plane codec.",
-               [({"direction": "out"}, float(w["bytes_out"])),
-                ({"direction": "in"}, float(w["bytes_in"]))])
+               "Wire bytes through the typed host-plane codec "
+               "(direction-only totals, plus per-scheme breakdown "
+               "series labelled scheme=).",
+               wire_samples)
         planes = hub.wire_plane_counters()
         if planes:
             # v6: plane-labelled byte counters (DESIGN.md §15) — the

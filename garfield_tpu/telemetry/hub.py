@@ -137,6 +137,10 @@ class MetricsHub:
             "encode_s": 0.0, "decode_s": 0.0, "send_queue_drops": 0,
         }
         self._wire_planes = {}  # plane -> {"bytes_out": n, "bytes_in": n}
+        # Schema v11 (round 18, the compressed wire): per-SCHEME byte
+        # breakdown (wire events' ``schemes`` sub-object) behind the
+        # garfield_wire_bytes_total{scheme=} Prometheus counters.
+        self._wire_schemes = {}  # scheme -> {"bytes_out": n, "bytes_in": n}
         # Elastic-membership accounting (schema v6, DESIGN.md §15):
         # folded from the PS autoscaler's "autoscale" events — running
         # active-worker count (the garfield_active_workers gauge) and
@@ -251,6 +255,12 @@ class MetricsHub:
                 for p, d in (fields.get("planes") or {}).items():
                     acc = self._wire_planes.setdefault(
                         str(p), {"bytes_out": 0, "bytes_in": 0}
+                    )
+                    acc["bytes_out"] += int(d.get("bytes_out", 0) or 0)
+                    acc["bytes_in"] += int(d.get("bytes_in", 0) or 0)
+                for s, d in (fields.get("schemes") or {}).items():
+                    acc = self._wire_schemes.setdefault(
+                        str(s), {"bytes_out": 0, "bytes_in": 0}
                     )
                     acc["bytes_out"] += int(d.get("bytes_out", 0) or 0)
                     acc["bytes_in"] += int(d.get("bytes_in", 0) or 0)
@@ -642,6 +652,15 @@ class MetricsHub:
                 self._wire_planes.items()
             )}
 
+    def wire_scheme_counters(self):
+        """Per-scheme wire byte totals ({scheme: {bytes_out, bytes_in}}),
+        or {} when no scheme-tagged wire event was folded (schema v11,
+        the round-18 compressed wire)."""
+        with self._lock:
+            return {s: dict(d) for s, d in sorted(
+                self._wire_schemes.items()
+            )}
+
     def autoscale_stats(self):
         """spawns/retires/active_workers over the run, or None when no
         autoscale event was folded (fixed-membership runs)."""
@@ -777,6 +796,7 @@ class MetricsHub:
                 },
             }
         wire_planes = self.wire_plane_counters()
+        wire_schemes = self.wire_scheme_counters()
         phases = self.phase_stats()
         if phases is not None:
             phases = {
@@ -848,6 +868,10 @@ class MetricsHub:
                 # schema v6: per-plane wire byte breakdown (None when no
                 # plane-tagged wire event was folded).
                 wire_planes=wire_planes or None,
+                # schema v11: per-scheme wire byte breakdown (None when
+                # no scheme-tagged wire event was folded — pre-round-18
+                # streams and compression-off runs).
+                wire_schemes=wire_schemes or None,
                 # schema v4: the async plane's staleness digest (None on
                 # synchronous runs — v3 consumers are unaffected).
                 staleness=stale,

@@ -10,9 +10,22 @@ could only be caught by a wrong-size frame. Every data frame now carries a
 
     magic   2s   b"GW"
     ver     u8   1
-    dtype   u8   low nibble: 0 = f32, 1 = bf16; HIGH nibble: plane tag
+    dtype   u8   low nibble: 0 = f32, 1 = bf16, 2 = int8, 3 = int4,
+                 4 = topk; HIGH nibble: plane tag
     elems   u64  logical float32 element count
     crc32   u32  zlib.crc32 of the payload bytes
+
+Round 18 (DESIGN.md §20) adds three LOSSY payload schemes behind new
+low-nibble tags. int8/int4 are linear per-block quantization — payload
+``[u32 block || ceil(elems/block) f32 scales || codes]`` with a
+symmetric grid per block; int4 packs biased nibbles (code + 8, so the
+honest grid is [1, 15] and nibble 0 is ban evidence). topk is
+sparsification — ``k`` little-endian ``(u32 index, f32 value)`` pairs
+with strictly-increasing indices ``< elems``. Every semantic violation
+(out-of-range scale, duplicate/descending/out-of-bounds index, nibble
+0) raises the same ``WireError`` as a CRC failure: the CRC proves the
+bytes are the sender's, so invalid *content* is attributable Byzantine
+evidence feeding the PR 4 quorum-exclusion ban path.
 
 The dtype byte's high nibble is the **plane tag** (DESIGN.md §15): only
 two of its 256 values were ever used, so the spare bits carry which
@@ -24,13 +37,18 @@ byte-identical to the pre-plane format, so every committed trajectory
 and artifact pins carry over; decoders reject only unknown LOW-nibble
 dtype tags, never a nonzero plane.
 
-``GARFIELD_WIRE_DTYPE=f32|bf16`` selects the SEND width (default f32).
-bf16 halves every gradient, model and gossip frame on the DCN; the f32
-setting keeps the payload bytes BYTE-IDENTICAL to the pre-codec
-``tobytes()`` format (modulo the header), so existing trajectory pins
-carry over. Decoding is dtype-driven by the header, never by the local
-setting — mixed-width deployments interoperate (each peer chooses its own
-send width, exactly like per-link compression).
+``GARFIELD_WIRE_DTYPE=f32|bf16|int8|int4`` selects the SEND width
+(default f32) and ``GARFIELD_WIRE_TOPK=<divisor>`` (default 0 = off)
+overlays top-k sparsification on the GRADIENT plane (cluster policy:
+model/gossip broadcasts are absolute state — a sparse model frame would
+zero most parameters on any catch-up read, see DESIGN.md §20 — so they
+keep the dense width). bf16 halves every gradient, model and gossip
+frame on the DCN; int8/int4 cut ~4x/~8x; top-k at the default divisor
+32 cuts 16x. The f32 setting keeps the payload bytes BYTE-IDENTICAL to
+the pre-codec ``tobytes()`` format (modulo the header), so existing
+trajectory pins carry over. Decoding is dtype-driven by the header,
+never by the local setting — mixed-width deployments interoperate (each
+peer chooses its own send width, exactly like per-link compression).
 
 The bf16 cast is pure numpy (no jax dependency — the exchange bench and
 its child processes stay jax-free): round-to-nearest-even on the high 16
@@ -57,15 +75,22 @@ import numpy as np
 
 __all__ = [
     "WIRE_DTYPES",
+    "WIRE_SCHEMES",
     "WireError",
+    "ErrorFeedback",
     "wire_dtype",
+    "wire_topk",
+    "topk_k",
     "check_plane",
     "encode",
     "decode",
     "frame_plane",
+    "frame_scheme",
     "frame_nbytes",
     "HEADER_NBYTES",
     "MAX_PLANE",
+    "QUANT_BLOCK",
+    "DEFAULT_TOPK_DIV",
 ]
 
 _HDR = struct.Struct("!2sBBQI")
@@ -74,10 +99,31 @@ _MAGIC = b"GW"
 _VERSION = 1
 _TAG_F32 = 0
 _TAG_BF16 = 1
-WIRE_DTYPES = ("f32", "bf16")
+# Round 18 (DESIGN.md §20): lossy compressed payload schemes behind new
+# LOW-nibble tags — the high (plane/shard) nibble semantics are
+# untouched, and tags 0/1 frames stay byte-identical to the PR 4 format.
+_TAG_INT8 = 2
+_TAG_INT4 = 3
+_TAG_TOPK = 4
+# Dense send widths selectable via GARFIELD_WIRE_DTYPE; "topk" is a
+# separate axis (GARFIELD_WIRE_TOPK) because it composes with a dense
+# width per plane rather than replacing it everywhere.
+WIRE_DTYPES = ("f32", "bf16", "int8", "int4")
+WIRE_SCHEMES = WIRE_DTYPES + ("topk",)
 _ITEMSIZE = {_TAG_F32: 4, _TAG_BF16: 2}
+_TAG_NAME = {_TAG_F32: "f32", _TAG_BF16: "bf16", _TAG_INT8: "int8",
+             _TAG_INT4: "int4", _TAG_TOPK: "topk"}
 # Plane tag (high nibble of the dtype byte — see the module docstring).
 MAX_PLANE = 0x0F
+# Linear-quantization block: one f32 scale per QUANT_BLOCK coordinates.
+# 1024 keeps the scale overhead under 0.4% of the codes while keeping a
+# single hot coordinate from flattening the whole frame's grid (a
+# per-frame scale hands one outlier coordinate veto power over every
+# other coordinate's resolution).
+QUANT_BLOCK = 1024
+# Default top-k sparsification divisor: keep ceil(d / 32) coordinates
+# (each an 8-byte index+value pair -> 16x fewer bytes than f32).
+DEFAULT_TOPK_DIV = 32
 
 
 class WireError(ValueError):
@@ -96,6 +142,40 @@ def wire_dtype():
             f"GARFIELD_WIRE_DTYPE must be one of {WIRE_DTYPES}, got {d!r}"
         )
     return d
+
+
+def wire_topk():
+    """The configured top-k sparsification DIVISOR (``GARFIELD_WIRE_TOPK``,
+    default 0 = off): gradient-plane frames keep the ceil(d / divisor)
+    largest-magnitude coordinates. A divisor, not an absolute k, so one
+    setting scales across every frame size in a deployment."""
+    v = os.environ.get("GARFIELD_WIRE_TOPK", "0").strip()
+    try:
+        div = int(v)
+    except ValueError:
+        raise ValueError(
+            f"GARFIELD_WIRE_TOPK must be a non-negative integer divisor, "
+            f"got {v!r}"
+        )
+    if div < 0:
+        raise ValueError(
+            f"GARFIELD_WIRE_TOPK must be >= 0 (0 = off), got {div}"
+        )
+    return div
+
+
+def topk_k(elems, div):
+    """Kept-coordinate count for an ``elems``-element frame at divisor
+    ``div`` — ceil(elems / div), floored at 1. The single shared
+    definition (host codec AND the in-graph twin, parallel/compress.py)
+    so the emulated and shipped sparsity cannot drift."""
+    elems = int(elems)
+    div = int(div)
+    if div < 1:
+        raise ValueError(f"top-k divisor must be >= 1, got {div}")
+    if elems <= 0:
+        return 0
+    return max(1, -(-elems // div))
 
 
 def _f32_to_bf16(vec):
@@ -135,14 +215,66 @@ def check_plane(plane, what="plane"):
     return plane
 
 
-def encode(vec, dtype=None, *, plane=0):
+def _quant_payload(vec, qmax, block):
+    """Linear per-block quantization payload: ``[u32 block || f32
+    scales || codes]`` with symmetric grid ``scale = max|x| / qmax`` per
+    block and round-to-nearest-even codes. An honest sender MUST fail
+    loudly on non-finite input (the scale would be inf/NaN and the
+    receiver's range check would turn the honest frame into ban
+    evidence); raising here keeps the fault local."""
+    if vec.size and not np.isfinite(vec).all():
+        raise ValueError(
+            "cannot quantize a non-finite vector — an inf/NaN scale "
+            "would make this honest frame indistinguishable from a "
+            "Byzantine one on the receiver's range check"
+        )
+    nblocks = -(-vec.size // block) if vec.size else 0
+    pad = nblocks * block - vec.size
+    x = np.pad(vec, (0, pad)) if pad else vec
+    xb = x.reshape(nblocks, block) if nblocks else x.reshape(0, block)
+    scales = (np.max(np.abs(xb), axis=1) / np.float32(qmax)).astype(
+        np.float32
+    )
+    safe = np.where(scales > 0, scales, np.float32(1.0))
+    codes = np.clip(
+        np.rint(xb / safe[:, None]), -qmax, qmax
+    ).astype(np.int8).reshape(-1)[: vec.size]
+    return (
+        np.array([block], "<u4").tobytes() + scales.tobytes(), codes
+    )
+
+
+def _dequant(codes, scales, block, elems):
+    nblocks = scales.size
+    pad = nblocks * block - elems
+    c = np.pad(codes.astype(np.float32), (0, pad)) if pad else \
+        codes.astype(np.float32)
+    out = (c.reshape(nblocks, block) * scales[:, None].astype(np.float32))
+    return out.reshape(-1)[:elems].astype(np.float32)
+
+
+_PAIR = np.dtype([("i", "<u4"), ("v", "<f4")])
+
+
+def encode(vec, dtype=None, *, plane=0, k=None, keep_from=None,
+           block=QUANT_BLOCK):
     """Encode a flat float32 vector as one typed frame.
 
-    ``dtype`` overrides the env-configured send width. f32 payload bytes
-    are the exact ``vec.tobytes()`` of the pre-codec format. ``plane``
-    (0..15) stamps the header's spare high-nibble plane tag — plane 0
-    keeps the frame byte-identical to the pre-plane format. Out-of-range
-    or non-integral tags fail loudly (``check_plane``), never truncate.
+    ``dtype`` overrides the env-configured send width, and may also be
+    ``"topk"`` (round 18): the payload becomes ``k`` sorted
+    ``(u32 index, f32 value)`` pairs — ``k`` explicit, or derived from
+    the ``GARFIELD_WIRE_TOPK`` divisor (``DEFAULT_TOPK_DIV`` when
+    unset). ``keep_from`` marks the start of an always-kept dense tail
+    (the ``[grad || stats]`` frames' BatchNorm segment: state, not an
+    additive signal — sparsifying it away would corrupt the robust-stats
+    fold, so its coordinates ride along as ordinary pairs). int8/int4
+    are linear per-block quantization (``block`` coordinates per f32
+    scale, carried in the payload and range-checked on decode). f32
+    payload bytes are the exact ``vec.tobytes()`` of the pre-codec
+    format. ``plane`` (0..15) stamps the header's spare high-nibble
+    plane tag — plane 0 keeps the frame byte-identical to the pre-plane
+    format. Out-of-range or non-integral tags fail loudly
+    (``check_plane``), never truncate.
     """
     vec = np.ascontiguousarray(np.asarray(vec).reshape(-1), np.float32)
     dtype = wire_dtype() if dtype is None else dtype
@@ -153,6 +285,50 @@ def encode(vec, dtype=None, *, plane=0):
     elif dtype == "f32":
         payload = vec.tobytes()
         tag = _TAG_F32
+    elif dtype in ("int8", "int4"):
+        block = int(block)
+        if block < 1:
+            raise ValueError(f"quantization block must be >= 1, got {block}")
+        qmax = 127 if dtype == "int8" else 7
+        head, codes = _quant_payload(vec, qmax, block)
+        if dtype == "int8":
+            payload = head + codes.tobytes()
+            tag = _TAG_INT8
+        else:
+            nib = (codes.astype(np.int16) + 8).astype(np.uint8)
+            if nib.size % 2:
+                nib = np.append(nib, np.uint8(8))  # pad nibble = code 0
+            payload = head + (nib[0::2] | (nib[1::2] << 4)).tobytes()
+            tag = _TAG_INT4
+    elif dtype == "topk":
+        head_n = vec.size if keep_from is None else int(keep_from)
+        if not 0 <= head_n <= vec.size:
+            raise ValueError(
+                f"keep_from must be in [0, {vec.size}], got {keep_from}"
+            )
+        if k is None:
+            k = topk_k(head_n, wire_topk() or DEFAULT_TOPK_DIV)
+        k = int(min(max(k, 0), head_n))
+        if k and not np.isfinite(vec[:head_n]).all():
+            # NaN never compares > anything: argpartition would silently
+            # demote real coordinates below garbage. Same honest-sender
+            # loud-failure contract as the quantizers.
+            raise ValueError("cannot top-k sparsify a non-finite vector")
+        if k >= head_n:
+            idx = np.arange(vec.size, dtype=np.uint32)
+        else:
+            top = np.argpartition(np.abs(vec[:head_n]), head_n - k)[
+                head_n - k:
+            ]
+            idx = np.concatenate([
+                np.sort(top).astype(np.uint32),
+                np.arange(head_n, vec.size, dtype=np.uint32),
+            ])
+        pairs = np.empty(idx.size, _PAIR)
+        pairs["i"] = idx
+        pairs["v"] = vec[idx.astype(np.int64)]
+        payload = pairs.tobytes()
+        tag = _TAG_TOPK
     else:
         raise ValueError(f"unknown wire dtype {dtype!r}")
     return _HDR.pack(
@@ -161,7 +337,7 @@ def encode(vec, dtype=None, *, plane=0):
     ) + payload
 
 
-def decode(buf, *, expect_plane=None):
+def decode(buf, *, expect_plane=None, expect_elems=None):
     """Decode a typed frame back to a float32 vector; raises WireError.
 
     Validation order matters for the ban path: header shape first (magic,
@@ -178,6 +354,16 @@ def decode(buf, *, expect_plane=None):
     mismatch is attributable ban evidence against the SENDER (a correct
     transport cannot restamp it without also failing magic/CRC), not a
     routing accident to shrug off.
+
+    ``expect_elems`` pins the header's dense element count. For the
+    dense and quantized schemes the payload length already corroborates
+    ``elems``, but a SPARSE frame's dense size is a bare header claim:
+    the k pairs are consistent with any ``elems > idx[-1]``, so a
+    Byzantine sender (or a bit flip in the u64) could cheaply demand a
+    multi-GB ``np.zeros(elems)`` scatter target. Quorum consumers know
+    their plane's d and MUST pass it (``cluster._frame_transform``
+    does); the mismatch rejects BEFORE any allocation, as the same
+    attributable WireError as the old wrong-length frame.
     """
     if len(buf) < HEADER_NBYTES:
         raise WireError(
@@ -198,19 +384,107 @@ def decode(buf, *, expect_plane=None):
             "delivery, attributable to the sender"
         )
     tag &= 0x0F  # the high nibble is the plane tag (frame_plane)
-    if tag not in _ITEMSIZE:
+    if tag not in _TAG_NAME:
         raise WireError(f"unknown dtype tag {tag}")
-    payload = buf[HEADER_NBYTES:]
-    if len(payload) != elems * _ITEMSIZE[tag]:
+    if expect_elems is not None and elems != int(expect_elems):
         raise WireError(
-            f"payload is {len(payload)} bytes but the header promises "
-            f"{elems} elements of {_ITEMSIZE[tag]} bytes"
+            f"frame promises {elems} elements, consumer expected "
+            f"{int(expect_elems)}"
         )
+    payload = buf[HEADER_NBYTES:]
+    # Structural length checks come BEFORE the CRC (cheap, and a
+    # truncated frame should say "truncated", not "CRC mismatch"); the
+    # semantic payload checks (scale range, index ordering) come AFTER —
+    # a frame whose bytes survive the CRC but whose *content* is invalid
+    # is exactly the attributable Byzantine case (only the sender could
+    # have produced those bytes), and must raise the same WireError that
+    # feeds the quorum-exclusion ban path.
+    if tag in _ITEMSIZE:
+        if len(payload) != elems * _ITEMSIZE[tag]:
+            raise WireError(
+                f"payload is {len(payload)} bytes but the header promises "
+                f"{elems} elements of {_ITEMSIZE[tag]} bytes"
+            )
+    elif tag in (_TAG_INT8, _TAG_INT4):
+        if len(payload) < 4:
+            raise WireError(
+                f"quantized payload is {len(payload)} bytes — too short "
+                "for the u32 block-size prefix"
+            )
+    else:  # _TAG_TOPK
+        if len(payload) % _PAIR.itemsize:
+            raise WireError(
+                f"sparse payload is {len(payload)} bytes — not a whole "
+                f"number of {_PAIR.itemsize}-byte (index, value) pairs"
+            )
+        if len(payload) // _PAIR.itemsize > elems:
+            raise WireError(
+                f"sparse payload carries {len(payload) // _PAIR.itemsize} "
+                f"pairs but the header promises only {elems} elements"
+            )
     if zlib.crc32(payload) != crc:
         raise WireError("payload CRC mismatch")
     if tag == _TAG_BF16:
         return _bf16_to_f32(np.frombuffer(payload, np.uint16))
-    return np.frombuffer(payload, np.float32)
+    if tag == _TAG_F32:
+        return np.frombuffer(payload, np.float32)
+    if tag in (_TAG_INT8, _TAG_INT4):
+        block = int(np.frombuffer(payload, "<u4", count=1)[0])
+        if block < 1:
+            raise WireError(f"quantization block {block} must be >= 1")
+        nblocks = -(-int(elems) // block) if elems else 0
+        codes_nbytes = (
+            int(elems) if tag == _TAG_INT8 else (int(elems) + 1) // 2
+        )
+        if len(payload) != 4 + nblocks * 4 + codes_nbytes:
+            raise WireError(
+                f"quantized payload is {len(payload)} bytes but "
+                f"{elems} elements at block {block} need "
+                f"{4 + nblocks * 4 + codes_nbytes}"
+            )
+        scales = np.frombuffer(payload, "<f4", count=nblocks, offset=4)
+        # Range check (the ISSUE's scale gate): a NaN/inf or negative
+        # scale lets a Byzantine sender smuggle unbounded or
+        # sign-flipped rows through an otherwise-valid frame.
+        if nblocks and not (np.isfinite(scales).all()
+                            and (scales >= 0).all()):
+            raise WireError(
+                "quantization scale out of range (non-finite or negative)"
+            )
+        raw = np.frombuffer(payload, np.uint8, offset=4 + nblocks * 4)
+        if tag == _TAG_INT8:
+            codes = raw.view(np.int8)
+        else:
+            nib = np.empty(raw.size * 2, np.uint8)
+            nib[0::2] = raw & 0x0F
+            nib[1::2] = raw >> 4
+            nib = nib[: int(elems)]
+            if nib.size and (nib == 0).any():
+                # The biased-nibble grid is [1, 15] (code -7..7 + 8);
+                # nibble 0 is unreachable by any honest encoder.
+                raise WireError("int4 nibble 0 is outside the biased grid")
+            codes = nib.astype(np.int16) - 8
+        return _dequant(codes, scales, block, int(elems))
+    # _TAG_TOPK: scatter the sorted (index, value) pairs into a dense
+    # f32 vector. Index validation is the sparse scheme's ban teeth —
+    # without it a Byzantine sender could double-count a coordinate
+    # (duplicate index) or write out of bounds.
+    pairs = np.frombuffer(payload, _PAIR)
+    idx = pairs["i"].astype(np.int64)
+    if idx.size:
+        if idx[-1] >= elems:
+            raise WireError(
+                f"sparse index {int(idx[-1])} out of bounds for "
+                f"{elems} elements"
+            )
+        if idx.size > 1 and not (np.diff(idx) > 0).all():
+            raise WireError(
+                "sparse indices must be strictly increasing "
+                "(duplicate or descending index)"
+            )
+    out = np.zeros(int(elems), np.float32)
+    out[idx] = pairs["v"]
+    return out
 
 
 def frame_plane(buf):
@@ -230,8 +504,112 @@ def frame_plane(buf):
     return tag >> 4
 
 
-def frame_nbytes(elems, dtype=None):
+def frame_scheme(buf):
+    """The payload scheme name of a typed frame's header ("f32", "bf16",
+    "int8", "int4", "topk"); raises WireError on a short header, bad
+    magic, or unknown low-nibble tag. Like ``frame_plane`` this reads
+    the header only — byte-accounting consumers label a frame's scheme
+    without paying the CRC."""
+    if len(buf) < HEADER_NBYTES:
+        raise WireError(
+            f"truncated frame: {len(buf)} bytes is shorter than the "
+            f"{HEADER_NBYTES}-byte header"
+        )
+    magic, ver, tag, _, _ = _HDR.unpack_from(buf)
+    if magic != _MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    tag &= 0x0F
+    if tag not in _TAG_NAME:
+        raise WireError(f"unknown dtype tag {tag}")
+    return _TAG_NAME[tag]
+
+
+def frame_nbytes(elems, dtype=None, *, k=None, block=QUANT_BLOCK):
     """Total wire bytes of an ``elems``-element frame at ``dtype`` —
-    the bench/telemetry accounting twin of ``encode``."""
+    the bench/telemetry accounting twin of ``encode``. For ``"topk"``,
+    ``k`` is the kept-pair count (default: the GARFIELD_WIRE_TOPK
+    divisor's ``topk_k``, falling back to DEFAULT_TOPK_DIV)."""
     dtype = wire_dtype() if dtype is None else dtype
-    return HEADER_NBYTES + int(elems) * (2 if dtype == "bf16" else 4)
+    elems = int(elems)
+    if dtype in ("f32", "bf16"):
+        return HEADER_NBYTES + elems * (2 if dtype == "bf16" else 4)
+    if dtype in ("int8", "int4"):
+        nblocks = -(-elems // int(block)) if elems else 0
+        codes = elems if dtype == "int8" else (elems + 1) // 2
+        return HEADER_NBYTES + 4 + nblocks * 4 + codes
+    if dtype == "topk":
+        if k is None:
+            k = topk_k(elems, wire_topk() or DEFAULT_TOPK_DIV)
+        return HEADER_NBYTES + int(k) * _PAIR.itemsize
+    raise ValueError(f"unknown wire dtype {dtype!r}")
+
+
+class ErrorFeedback:
+    """Host-side error-feedback accumulators, one residual per key.
+
+    Compressed SGD with a biased compressor (quantization, top-k)
+    diverges unless the compression error is fed back into the next
+    step's signal (Karimireddy et al., EF-SGD): the sender transmits
+    ``C(g + e)`` and keeps ``e' = (g + e) - dequant(C(g + e))``. The
+    cluster roles key the accumulator per PLANE — every frame is
+    broadcast byte-identical to all peers, so per sender x plane is the
+    full resolution ("per peer x plane" collapses to it; a per-LINK
+    residual would let the same process drift different totals to
+    different receivers).
+
+    Error feedback applies to the GRADIENT plane's additive head segment
+    only. Model/gossip broadcasts are absolute state, not an additive
+    signal — accumulating their quantization error would smear stale
+    parameters into fresh ones (DESIGN.md §20) — and the BN-stats tail
+    of a ``[grad || stats]`` frame is robust-stats input, shipped dense.
+
+    RESTART SEMANTICS (documented, not silent): the host accumulator is
+    rebuilt at zero when a cluster role restarts — the residual is a
+    bounded one-step correction (||e|| <= the per-step compression
+    error), so dropping it costs one step of compensation, not
+    convergence. Bitwise-reproducible resume lives on the in-graph twin
+    (parallel/compress.py), whose residual rides ``TrainState`` through
+    checkpoints; the cluster role logs the rebuild via its startup
+    banner so a resumed run's telemetry shows the reset.
+    """
+
+    def __init__(self):
+        self._resid = {}
+
+    def compensate(self, key, vec, *, upto=None):
+        """``vec + residual[key]`` over ``[0, upto)`` (default: all of
+        ``vec``); returns a fresh f32 array. Shape changes (a different
+        model) reset the key's residual to zero loudly-by-construction:
+        the stale residual is discarded, not broadcast-added."""
+        vec = np.ascontiguousarray(np.asarray(vec).reshape(-1), np.float32)
+        e = self._resid.get(key)
+        upto = vec.size if upto is None else int(upto)
+        out = vec.copy()
+        if e is not None and e.size == upto:
+            out[:upto] += e
+        return out
+
+    def update(self, key, compensated, decoded, *, upto=None):
+        """Store ``compensated - decoded`` over ``[0, upto)`` as the
+        key's next residual. ``decoded`` must be the receiver-side
+        dequantization of the frame actually sent (a full codec round
+        trip), so the residual is exactly the error every peer saw."""
+        upto = compensated.size if upto is None else int(upto)
+        self._resid[key] = (
+            compensated[:upto] - decoded[:upto]
+        ).astype(np.float32)
+
+    def residual_norm(self, key):
+        """L2 norm of the key's residual (0.0 when absent) — the
+        telemetry ``ef_residual_norm`` field on the ``wire`` event."""
+        e = self._resid.get(key)
+        return float(np.linalg.norm(e)) if e is not None else 0.0
+
+    def total_norm(self):
+        """L2 norm over ALL keys' residuals — the role-level
+        ``ef_residual_norm`` a WireStats flush reports."""
+        sq = sum(
+            float(np.sum(e.astype(np.float64) ** 2))
+            for e in self._resid.values()
+        )
+        return float(np.sqrt(sq))

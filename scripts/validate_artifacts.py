@@ -22,7 +22,12 @@ data/escalate+data defense strings) — and the v10 federated additions
 (the ``fed_bench`` kind behind FEDBENCH_r*'s scaling / s1_bitwise /
 fleet rows, the ``fed_round`` event with its per-shard digest, the
 ``cohort`` event's matched-length client_ids/selected lists, and
-``summary.federated`` with its client-id-keyed top_clients map).
+``summary.federated`` with its client-id-keyed top_clients map) — and
+the v11 compression additions (the ``wire`` event's per-scheme byte
+breakdown + compression_ratio/ef_residual_norm, ``summary.wire_schemes``,
+and EXCHBENCH_r05's ``--robust`` exchange_bench rows with their
+cell/matched_accuracy/headroom columns; auto-globbed like every
+``*_r*.jsonl``).
 
   python scripts/validate_artifacts.py            # repo root auto-found
   python scripts/validate_artifacts.py /some/repo

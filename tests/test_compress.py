@@ -1,0 +1,220 @@
+"""In-graph compressed-wire emulation (parallel/compress.py, DESIGN.md §20).
+
+Three contracts pinned tier-1:
+
+1. **Host <-> graph parity**: the jitted quantizer grid equals the host
+   codec's bit-for-bit (int8/int4/bf16); the sparsifier matches on
+   tie-free inputs (the documented parity boundary — lax.top_k vs
+   argpartition tie-breaking is NOT pinned).
+2. **Trainer integration**: ``wire=`` off is a bitwise no-op; on, the
+   compressed plane still trains and exposes the residual-norm metric.
+3. **Bitwise resume**: the EF residual lives in ``TrainState.wire_state``
+   — chunked dispatch and a mid-run checkpoint/restore (with NON-ZERO
+   residuals at the cut) reproduce the straight run bit-for-bit. The
+   host-side accumulator's restart-at-zero is a separate, documented
+   semantic (wire.ErrorFeedback docstring), not covered here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from garfield_tpu import models
+from garfield_tpu.parallel import aggregathor, compress, core
+from garfield_tpu.utils import checkpoint as ckpt_lib, selectors, wire
+
+NUM_BATCHES = 3
+
+
+def _setup():
+    module = models.select_model("pimanet", "pima")
+    loss = selectors.select_loss("bce")
+    opt = selectors.select_optimizer("sgd", lr=0.05, momentum=0.9)
+    return module, loss, opt
+
+
+def _batch_stack(seed=0, bsz=16):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(8, NUM_BATCHES, bsz, 8)).astype(np.float32)
+    y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _assert_bitwise_equal(ref, got):
+    ra = jax.tree.leaves(jax.device_get(ref))
+    ga = jax.tree.leaves(jax.device_get(got))
+    assert len(ra) == len(ga)
+    for a, b in zip(ra, ga):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --- host <-> graph parity ---------------------------------------------------
+
+
+def test_quantizer_grid_matches_host_codec_bitwise():
+    """The emulated robustness matrix must measure the SHIPPED wire: the
+    in-graph per-block grid (scale, RNE rounding, clip) equals the host
+    encode->decode bit-for-bit, including the block-boundary padding and
+    an all-zero block's zero scale."""
+    rng = np.random.default_rng(0)
+    rows = (rng.standard_normal((3, 2500)) * 4).astype(np.float32)
+    rows[1, :1024] = 0.0  # one all-zero block: scale 0, codes 0
+    for scheme in ("int8", "int4", "bf16"):
+        graph = np.asarray(compress.roundtrip_rows(jnp.asarray(rows), scheme))
+        host = np.stack([
+            wire.decode(wire.encode(r, scheme)) for r in rows
+        ])
+        np.testing.assert_array_equal(graph, host)
+
+
+def test_topk_matches_host_on_tie_free_rows():
+    rng = np.random.default_rng(1)
+    rows = rng.standard_normal((2, 400)).astype(np.float32)  # ties: P=0
+    k = 25
+    graph = np.asarray(
+        compress.roundtrip_rows(jnp.asarray(rows), "topk", k=k)
+    )
+    host = np.stack([
+        wire.decode(wire.encode(r, "topk", k=k)) for r in rows
+    ])
+    np.testing.assert_array_equal(graph, host)
+    assert (np.count_nonzero(graph, axis=1) == k).all()
+
+
+def test_topk_tie_keeps_at_least_k():
+    """Ties at the k-th magnitude: the threshold mask keeps every tied
+    coordinate (>= k survive) rather than an arbitrary subset — the
+    documented drift from the host's exactly-k frames."""
+    rows = jnp.asarray([[1.0, -1.0, 1.0, 0.5, 0.25]], jnp.float32)
+    out = np.asarray(compress.roundtrip_rows(rows, "topk", k=2))
+    assert np.count_nonzero(out) == 3  # all three tied |1.0| kept
+
+
+def test_ef_roundtrip_rows_matches_host_accumulator():
+    """One EF step in-graph == one host ErrorFeedback step around the
+    codec, bitwise (int8 path; the parity anchor the resume tests lean
+    on)."""
+    rng = np.random.default_rng(2)
+    rows = rng.standard_normal((2, 300)).astype(np.float32)
+    resid0 = rng.standard_normal((2, 300)).astype(np.float32) * 0.01
+    sent, resid = compress.ef_roundtrip_rows(
+        jnp.asarray(rows), jnp.asarray(resid0), "int8"
+    )
+    ef = wire.ErrorFeedback()
+    for i in range(2):
+        ef._resid[i] = resid0[i]
+        comp = ef.compensate(i, rows[i])
+        dec = wire.decode(wire.encode(comp, "int8"))
+        ef.update(i, comp, dec)
+        np.testing.assert_array_equal(np.asarray(sent)[i], dec)
+        np.testing.assert_array_equal(np.asarray(resid)[i], ef._resid[i])
+
+
+def test_roundtrip_rows_validates():
+    rows = jnp.ones((1, 8), jnp.float32)
+    with pytest.raises(ValueError):
+        compress.roundtrip_rows(rows, "f16")
+    with pytest.raises(ValueError):
+        compress.roundtrip_rows(rows, "topk")  # k is required
+
+
+# --- trainer integration -----------------------------------------------------
+
+
+def _trainer(wire_kw, **kw):
+    module, loss, opt = _setup()
+    return aggregathor.make_trainer(
+        module, loss, opt, "krum", num_workers=8, f=2, attack="lie",
+        wire=wire_kw, **kw,
+    )
+
+
+def _run(init_fn, step_fn, steps, state=None, start=0):
+    xs, ys = _batch_stack()
+    if state is None:
+        state = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+    metrics = []
+    for i in range(start, start + steps):
+        state, m = step_fn(state, xs[:, i % NUM_BATCHES],
+                           ys[:, i % NUM_BATCHES])
+        metrics.append(jax.device_get(m))
+    return state, metrics
+
+
+def test_wire_off_is_bitwise_noop():
+    """``wire=None`` and the explicit f32/no-topk spelling trace the SAME
+    program: identical params, and no wire_state is allocated."""
+    init_a, step_a, _ = _trainer(None)
+    init_b, step_b, _ = _trainer({"dtype": "f32", "topk": 0})
+    sa, _ = _run(init_a, step_a, 4)
+    sb, _ = _run(init_b, step_b, 4)
+    assert sa.wire_state is None and sb.wire_state is None
+    _assert_bitwise_equal(sa.params, sb.params)
+
+
+def test_compressed_plane_trains_and_reports_residual():
+    for wire_kw in ({"dtype": "int8"}, {"dtype": "int4"},
+                    {"topk": 32}, {"dtype": "bf16"}):
+        init_fn, step_fn, _ = _trainer(dict(wire_kw))
+        state, metrics = _run(init_fn, step_fn, 3)
+        assert np.isfinite(metrics[-1]["loss"])
+        ef_expected = wire_kw != {"dtype": "bf16"}  # bf16 is EF-free
+        assert (state.wire_state is not None) == ef_expected
+        assert ("wire_resid_norm" in metrics[-1]) == ef_expected
+        if ef_expected:
+            # Lossy compression of a real gradient leaves a residual.
+            assert float(np.max(metrics[-1]["wire_resid_norm"])) > 0
+            assert np.asarray(state.wire_state["resid"]).any()
+
+
+def test_wire_kwarg_validates():
+    with pytest.raises(ValueError, match="unknown wire"):
+        _trainer({"dtype": "int8", "bogus": 1})
+    with pytest.raises(ValueError):
+        _trainer({"dtype": "f16"})
+    with pytest.raises(ValueError):
+        _trainer({"topk": -1})
+
+
+# --- bitwise chunked + resume ------------------------------------------------
+
+
+def test_ef_chunked_bitwise_equal():
+    """The EF residual is scan-carry state: K-step chunks equal per-step
+    dispatches bit-for-bit, wire_state included."""
+    init_fn, step_fn, _ = _trainer({"dtype": "int8"})
+    xs, ys = _batch_stack()
+    state0 = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+    ref, _ = _run(init_fn, step_fn, 6, state=state0)
+    # K sweep stays lean (one compile per K on the 1-core suite box);
+    # test_chunked.py owns the general K-alignment sweep.
+    for K in (2, 6):
+        fn = core.make_chunked_step(step_fn, K, NUM_BATCHES)
+        state = state0
+        for i in range(0, 6, K):
+            state, _ = fn(state, xs, ys, np.int32(i))
+        _assert_bitwise_equal(ref, state)
+        assert np.asarray(state.wire_state["resid"]).any()
+
+
+@pytest.mark.parametrize("wire_kw", [{"dtype": "int8"}, {"topk": 16}])
+def test_ef_checkpoint_resume_bitwise(tmp_path, wire_kw):
+    """Mid-run resume with NON-ZERO residuals: save at step 3 through the
+    real checkpoint path (pickle-of-numpy on CPU), restore, run 3 more —
+    bitwise equal to the uninterrupted 6-step run. This is the in-graph
+    twin's half of the EF restart contract; the HOST accumulator
+    deliberately rebuilds at zero on role restart (announced via the
+    startup banner — wire.ErrorFeedback docstring), which is why bitwise
+    resume lives here and not in apps/cluster."""
+    init_fn, step_fn, _ = _trainer(dict(wire_kw))
+    straight, _ = _run(init_fn, step_fn, 6)
+
+    half, _ = _run(init_fn, step_fn, 3)
+    assert np.asarray(half.wire_state["resid"]).any(), \
+        "resume must carry a non-trivial residual to prove anything"
+    ckpt_lib.save(tmp_path, 3, half)
+    restored = ckpt_lib.restore(tmp_path, half)
+    restored = jax.tree.map(jnp.asarray, restored)
+    resumed, _ = _run(init_fn, step_fn, 3, state=restored, start=3)
+    _assert_bitwise_equal(straight, resumed)

@@ -145,13 +145,21 @@ def test_fuzz_corrupted_frames_never_decode():
     crc-verified), so nothing corrupted can reach a GAR through that
     nibble either. (A payload flip breaks the crc; any other header flip
     breaks magic/version/tag/length; a truncation breaks the length
-    contract.)"""
+    contract.)
+
+    Round 18: the fuzz runs over EVERY payload scheme (int8/int4/topk
+    included), decoding as the cluster consumer does — with
+    ``expect_elems`` — because a sparse frame's dense size is a bare
+    header claim the payload cannot corroborate (an ``elems`` bit flip
+    on a topk frame passes every structural check and the CRC, and
+    without the pin would scatter into a wrong-sized or multi-GB zeros
+    vector)."""
     rng = np.random.default_rng(3)
     v = rng.standard_normal(257).astype(np.float32)
     # dtype byte = header byte 3 ("!2sBBQI"); its high nibble is the
     # plane tag.
     plane_bits = {3 * 8 + b for b in (4, 5, 6, 7)}
-    for dtype in wire.WIRE_DTYPES:
+    for dtype in wire.WIRE_SCHEMES:
         frame = wire.encode(v, dtype)
         baseline = wire.decode(frame)
         # exhaustive over the header, random over the payload
@@ -168,16 +176,308 @@ def test_fuzz_corrupted_frames_never_decode():
                 assert wire.frame_plane(bytes(ba)) != 0
                 continue
             with pytest.raises(wire.WireError):
-                wire.decode(bytes(ba))
+                wire.decode(bytes(ba), expect_elems=v.size)
         for cut in list(range(0, wire.HEADER_NBYTES + 2)) + list(
             rng.integers(0, len(frame), 60)
         ):
             with pytest.raises(wire.WireError):
-                wire.decode(frame[:int(cut)])
+                wire.decode(frame[:int(cut)], expect_elems=v.size)
         with pytest.raises(wire.WireError):
-            wire.decode(frame + b"x")  # trailing garbage
+            # trailing garbage
+            wire.decode(frame + b"x", expect_elems=v.size)
     with pytest.raises(wire.WireError):
         wire.decode(b"")  # the SSMW stop sentinel must not decode
+
+
+def test_fuzz_dense_schemes_self_validate_without_expect_elems():
+    """The PR 4 contract stands on its own for the dense/quantized
+    schemes: every non-plane header flip and truncation rejects WITHOUT
+    ``expect_elems`` (payload length corroborates the element count).
+    The sparse scheme is the documented exception — covered above with
+    the pin and below by the forged-elems test."""
+    rng = np.random.default_rng(7)
+    v = rng.standard_normal(129).astype(np.float32)
+    plane_bits = {3 * 8 + b for b in (4, 5, 6, 7)}
+    for dtype in wire.WIRE_DTYPES:
+        frame = wire.encode(v, dtype)
+        for bit in range(wire.HEADER_NBYTES * 8):
+            if bit in plane_bits:
+                continue
+            ba = bytearray(frame)
+            ba[bit // 8] ^= 1 << (bit % 8)
+            with pytest.raises(wire.WireError):
+                wire.decode(bytes(ba))
+
+
+# --- round 18: compressed schemes (int8 / int4 / topk) -----------------------
+
+
+def _forge(tag, elems, payload, plane=0):
+    """A CRC-valid frame with arbitrary payload bytes — what a Byzantine
+    sender (who controls its wire bytes, CRC included) can actually
+    produce. The semantic rejects below must fire AFTER the CRC passes:
+    that ordering is what makes them attributable ban evidence."""
+    import struct
+    import zlib
+
+    return struct.pack(
+        "!2sBBQI", b"GW", 1, (plane << 4) | tag, elems,
+        zlib.crc32(payload),
+    ) + payload
+
+
+def _topk_payload(idx, val):
+    pairs = np.empty(len(idx), np.dtype([("i", "<u4"), ("v", "<f4")]))
+    pairs["i"] = idx
+    pairs["v"] = val
+    return pairs.tobytes()
+
+
+def test_int8_roundtrip_error_bound_and_nbytes():
+    rng = np.random.default_rng(10)
+    v = (rng.standard_normal(3000) * 3).astype(np.float32)
+    frame = wire.encode(v, "int8")
+    assert len(frame) == wire.frame_nbytes(v.size, "int8")
+    out = wire.decode(frame)
+    # Linear grid: per-block max error <= scale / 2 = max|block| / 254.
+    for b in range(0, v.size, wire.QUANT_BLOCK):
+        blk = v[b:b + wire.QUANT_BLOCK]
+        bound = np.abs(blk).max() / 127 / 2 + 1e-7
+        assert np.abs(out[b:b + wire.QUANT_BLOCK] - blk).max() <= bound
+    # Zero vector: zero scale, exact roundtrip.
+    z = wire.decode(wire.encode(np.zeros(100, np.float32), "int8"))
+    np.testing.assert_array_equal(z, np.zeros(100))
+
+
+def test_int4_roundtrip_error_bound_and_padding():
+    rng = np.random.default_rng(11)
+    for n in (7, 8, 257):  # odd sizes exercise the pad nibble
+        v = rng.standard_normal(n).astype(np.float32)
+        frame = wire.encode(v, "int4")
+        assert len(frame) == wire.frame_nbytes(n, "int4")
+        out = wire.decode(frame)
+        bound = np.abs(v).max() / 7 / 2 + 1e-6
+        assert np.abs(out - v).max() <= bound
+
+
+def test_topk_roundtrip_keeps_largest_and_dense_tail():
+    rng = np.random.default_rng(12)
+    v = rng.standard_normal(1000).astype(np.float32)
+    k = 50
+    frame = wire.encode(v, "topk", k=k)
+    assert len(frame) == wire.frame_nbytes(v.size, "topk", k=k)
+    out = wire.decode(frame)
+    kept = np.flatnonzero(out)
+    assert kept.size == k
+    # The kept coordinates are exactly the k largest magnitudes.
+    top = np.sort(np.argpartition(np.abs(v), v.size - k)[v.size - k:])
+    np.testing.assert_array_equal(kept, top)
+    np.testing.assert_array_equal(out[kept], v[kept])
+    # keep_from: the stats tail (BatchNorm segment) always rides along.
+    tail_frame = wire.encode(v, "topk", k=10, keep_from=990)
+    out = wire.decode(tail_frame)
+    np.testing.assert_array_equal(out[990:], v[990:])
+    assert np.flatnonzero(out[:990]).size == 10
+
+
+def test_quantized_encode_rejects_non_finite_loudly():
+    """Honest-sender loud failure: a NaN/inf input would produce a
+    non-finite scale — indistinguishable on the wire from a Byzantine
+    frame — so encode raises a plain ValueError (NOT WireError: there is
+    no frame, and nobody to ban) instead of shipping it."""
+    bad = np.array([1.0, np.nan, 2.0], np.float32)
+    for scheme in ("int8", "int4", "topk"):
+        with pytest.raises(ValueError) as ei:
+            wire.encode(bad, scheme)
+        assert not isinstance(ei.value, wire.WireError)
+    inf = np.array([1.0, np.inf], np.float32)
+    with pytest.raises(ValueError):
+        wire.encode(inf, "int8")
+    # bf16/f32 still pass specials through (the NaN-laundering pin in
+    # test_bf16_roundtrip_within_cast_tolerance).
+    wire.encode(bad, "f32")
+
+
+def test_quantized_scale_range_rejected_post_crc():
+    """The ISSUE's scale gate: CRC-valid frames whose carried scale is
+    non-finite or negative reject as WireError with .nbytes — the
+    attributable Byzantine case (only the sender makes those bytes)."""
+    v = np.ones(8, np.float32)
+    honest = wire.encode(v, "int8")
+    head = honest[:wire.HEADER_NBYTES]
+    payload = bytearray(honest[wire.HEADER_NBYTES:])
+    for evil_scale in (np.inf, -np.inf, np.nan, -1.0):
+        p = bytearray(payload)
+        p[4:8] = np.float32(evil_scale).tobytes()
+        frame = _forge(2, v.size, bytes(p))
+        with pytest.raises(wire.WireError, match="scale"):
+            wire.decode(frame)
+    del head
+    # block = 0 in the payload prefix: division bomb, rejected by name.
+    p = bytearray(payload)
+    p[0:4] = np.zeros(1, "<u4").tobytes()
+    with pytest.raises(wire.WireError, match="block"):
+        wire.decode(_forge(2, v.size, bytes(p)))
+
+
+def test_int4_nibble_zero_rejected():
+    """Nibble 0 is outside the biased [1, 15] grid — unreachable by any
+    honest encoder, so its presence is ban evidence, not a value."""
+    v = np.ones(4, np.float32)
+    honest = wire.encode(v, "int4")
+    payload = bytearray(honest[wire.HEADER_NBYTES:])
+    payload[-1] &= 0xF0  # zero the low nibble of the last code byte
+    with pytest.raises(wire.WireError, match="nibble"):
+        wire.decode(_forge(3, v.size, bytes(payload)))
+
+
+def test_sparse_index_attacks_rejected_post_crc():
+    """Every malformed-sparse shape the ISSUE names, as CRC-valid forged
+    frames: duplicate index (double-count), descending index, index out
+    of bounds, more pairs than elems, and a non-whole-pair payload. All
+    WireError; the quorum path stamps .nbytes (integration test below)."""
+    cases = [
+        (_topk_payload([3, 3, 5], [1, 2, 3]), "increasing"),   # duplicate
+        (_topk_payload([5, 3, 7], [1, 2, 3]), "increasing"),   # descending
+        (_topk_payload([0, 2, 16], [1, 2, 3]), "bounds"),      # oob last
+        (_topk_payload(range(17), np.ones(17)), "pairs"),      # k > elems
+        (_topk_payload([0, 1], [1, 2])[:-3], "pairs"),         # ragged
+    ]
+    for payload, msg in cases:
+        with pytest.raises(wire.WireError, match=msg):
+            wire.decode(_forge(4, 16, payload))
+    # Monotonicity + in-bounds LAST index suffices: any strictly
+    # increasing sequence with an out-of-bounds middle element must have
+    # an out-of-bounds last element too.
+    ok = wire.decode(_forge(4, 16, _topk_payload([0, 7, 15], [1, 2, 3])))
+    np.testing.assert_array_equal(np.flatnonzero(ok), [0, 7, 15])
+
+
+def test_sparse_elems_claim_pinned_by_consumer():
+    """A sparse frame's dense size is a bare header claim (the pairs are
+    consistent with ANY larger elems): an honestly-CRC'd frame claiming
+    2^40 elements must reject on the consumer's ``expect_elems`` pin
+    BEFORE the scatter allocates a 4 TB zeros vector."""
+    payload = _topk_payload([0, 1], [1.0, 2.0])
+    giant = _forge(4, 2 ** 40, payload)
+    with pytest.raises(wire.WireError, match="expected"):
+        wire.decode(giant, expect_elems=16)
+    # Dense consumers get the same pin for free (belt over the length
+    # check) — and honest frames pass it.
+    v = np.ones(16, np.float32)
+    for scheme in wire.WIRE_SCHEMES:
+        out = wire.decode(wire.encode(v, scheme), expect_elems=16)
+        assert out.size == 16
+        with pytest.raises(wire.WireError):
+            wire.decode(wire.encode(v, scheme), expect_elems=17)
+
+
+def test_unknown_low_nibble_tags_reject_loudly():
+    """Forward/backward compat: tags 5..15 are unassigned — a frame
+    stamped with one rejects by name on THIS decoder (and tags 2/3/4
+    reject identically on a PR 4 decoder, which knew only 0/1), so a
+    mixed-version deployment fails loudly instead of misinterpreting
+    payload bytes."""
+    for tag in range(5, 16):
+        with pytest.raises(wire.WireError, match="tag"):
+            wire.decode(_forge(tag, 4, b"\x00" * 16))
+        with pytest.raises(wire.WireError, match="tag"):
+            wire.frame_scheme(_forge(tag, 4, b""))
+
+
+def test_f32_bf16_golden_frames_unchanged():
+    """Backward-compat pin: the PR 4 wire format for f32/bf16 is frozen
+    byte-for-byte — adding the compressed tags must not move a single
+    bit of the dense frames (a mixed-version fleet keeps interoperating
+    on the dense schemes)."""
+    v = np.array([0.0, 1.0, -2.5], np.float32)
+    f32 = wire.encode(v, "f32")
+    assert f32.hex() == (
+        "47570100"              # "GW", ver 1, tag 0 (f32, plane 0)
+        "0000000000000003"      # elems = 3 (big-endian u64)
+        "48f41bf2"              # crc32 of the payload below
+        "000000000000803f0000"  # 0.0f, 1.0f, -2.5f little-endian
+        "20c0"
+    )
+    bf16 = wire.encode(v, "bf16")
+    assert bf16.hex() == (
+        "47570101" "0000000000000003" "7d4c5327"
+        "0000803f20c0"          # bf16 halves of the same three values
+    )
+    assert wire.frame_scheme(f32) == "f32"
+    assert wire.frame_scheme(bf16) == "bf16"
+
+
+def test_frame_scheme_reads_all_tags():
+    v = np.ones(8, np.float32)
+    for scheme in wire.WIRE_SCHEMES:
+        assert wire.frame_scheme(wire.encode(v, scheme)) == scheme
+    with pytest.raises(wire.WireError):
+        wire.frame_scheme(b"short")
+
+
+def test_topk_env_divisor_and_topk_k(monkeypatch):
+    monkeypatch.delenv("GARFIELD_WIRE_TOPK", raising=False)
+    assert wire.wire_topk() == 0
+    monkeypatch.setenv("GARFIELD_WIRE_TOPK", "32")
+    assert wire.wire_topk() == 32
+    v = np.arange(1, 101, dtype=np.float32)
+    frame = wire.encode(v, "topk")  # k = ceil(100/32) = 4 from the env
+    assert np.flatnonzero(wire.decode(frame)).size == 4
+    monkeypatch.setenv("GARFIELD_WIRE_TOPK", "-1")
+    with pytest.raises(ValueError):
+        wire.wire_topk()
+    monkeypatch.setenv("GARFIELD_WIRE_TOPK", "x")
+    with pytest.raises(ValueError):
+        wire.wire_topk()
+    assert wire.topk_k(100, 32) == 4
+    assert wire.topk_k(0, 32) == 0
+    assert wire.topk_k(1, 1000) == 1
+    with pytest.raises(ValueError):
+        wire.topk_k(100, 0)
+
+
+def test_error_feedback_accumulator():
+    """EF-SGD's host accumulator: on a CONSTANT signal the residual makes
+    the mean sent value converge to the signal exactly (the bias a bare
+    quantizer keeps forever); a residual of the wrong size (model resize
+    / restart) is discarded, not misapplied."""
+    ef = wire.ErrorFeedback()
+    signal = np.full(64, 0.01, np.float32)  # far below one int8 step
+    sent_sum = np.zeros(64, np.float64)
+    n = 50
+    for _ in range(n):
+        comp = ef.compensate(1, signal)
+        frame = wire.encode(comp, "int8")
+        dec = wire.decode(frame)
+        ef.update(1, comp, dec)
+        sent_sum += dec
+    np.testing.assert_allclose(sent_sum / n, signal, rtol=1e-5)
+    assert ef.residual_norm(1) >= 0
+    assert ef.total_norm() == pytest.approx(ef.residual_norm(1))
+    # Wrong-size (stale) residual: discarded, compensate is identity.
+    other = np.ones(32, np.float32)
+    np.testing.assert_array_equal(ef.compensate(1, other), other)
+    # Unknown key: identity too.
+    np.testing.assert_array_equal(ef.compensate(9, other), other)
+    assert ef.residual_norm(9) == 0.0
+
+
+def test_error_feedback_upto_leaves_tail_uncompensated():
+    """``upto`` scopes EF to the additive head segment: the stats tail
+    (BatchNorm running stats — state, not a gradient) must never receive
+    residual corrections."""
+    ef = wire.ErrorFeedback()
+    vec = np.concatenate([np.full(8, 0.01), np.ones(4)]).astype(np.float32)
+    comp = ef.compensate(0, vec, upto=8)
+    frame = wire.encode(comp, "int8")
+    dec = wire.decode(frame)
+    ef.update(0, comp, dec, upto=8)
+    comp2 = ef.compensate(0, vec, upto=8)
+    # Head got compensation (the residual is non-zero there)...
+    assert not np.array_equal(comp2[:8], vec[:8])
+    # ...the tail is passed through untouched.
+    np.testing.assert_array_equal(comp2[8:], vec[8:])
 
 
 # --- exchange integration (native runtime required) -------------------------
@@ -308,6 +608,59 @@ def test_gradient_quorum_bans_corrupt_codec_frames():
         events = [r for r in hub.records()
                   if r.get("event") == "quorum_exclusion"]
         assert events and all(e["rank"] == 2 for e in events)
+    finally:
+        tele_hub.uninstall()
+        if prev is not None:
+            tele_hub.install(prev)
+        for p in peers:
+            p.close()
+
+
+@needs_native
+def test_gradient_quorum_bans_malformed_sparse_frames():
+    """Round 18: a CRC-VALID topk frame with duplicate sparse indices (a
+    forged frame only its sender could produce — the Byzantine case, not
+    line noise) feeds the SAME quorum-exclusion path as a CRC reject:
+    never reaches the aggregation, sender banned, ``quorum_exclusion``
+    telemetry attributed. Extends the PR 4 codec-reject ban surface to
+    the compressed schemes' semantic checks."""
+    from garfield_tpu.apps.cluster import _gradient_quorum
+    from garfield_tpu.telemetry import hub as tele_hub
+
+    d = 32
+    rng = np.random.default_rng(6)
+    honest = rng.standard_normal(d).astype(np.float32)
+    forged = _forge(
+        4, d, _topk_payload([3, 3, 9], [5.0, -5.0, 1.0]), plane=1,
+    )
+    assert len(forged) >= wire.HEADER_NBYTES
+    hub = tele_hub.MetricsHub()
+    prev = tele_hub.install(hub)
+    peers = _mesh(3)  # 0 = PS, 1 = honest worker, 2 = Byzantine sender
+    try:
+        peers[2].publish(0, forged, to=[0])
+        t = threading.Timer(
+            0.3, lambda: peers[1].publish(
+                0, wire.encode(honest, "f32", plane=1), to=[0]
+            )
+        )
+        t.start()
+        deadline = time.time() + 10
+        while peers[0]._mb.version(2) < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        got, good = _gradient_quorum(
+            peers[0], 0, 1, [1, 2], (d, 0),
+            republish=lambda: None, timeout_ms=10_000, who="test-ps",
+        )
+        t.join()
+        assert good == [1]
+        assert set(got) == {1}
+        np.testing.assert_array_equal(np.asarray(got[1][0]), honest)
+        events = [r for r in hub.records()
+                  if r.get("event") == "quorum_exclusion"]
+        assert events and all(e["rank"] == 2 for e in events)
+        # The ban evidence carries the observed frame length.
+        assert any(e.get("got_bytes") == len(forged) for e in events)
     finally:
         tele_hub.uninstall()
         if prev is not None:
