@@ -568,7 +568,7 @@ class StreamingAggregator:
 
     def __init__(self, n, f, *, bucket_gar="krum", top_gar=None,
                  bucket_size=None, levels="auto", wave_buckets=8,
-                 audit=False, telemetry=False):
+                 audit=False, telemetry=False, d=None):
         self.plan = plan_hierarchy(n, f, bucket_gar, top_gar, bucket_size,
                                    levels)
         self.n = int(n)
@@ -578,7 +578,13 @@ class StreamingAggregator:
         self._audit = bool(audit) or self._telemetry
         self._lock = threading.RLock()
         self._arrived = 0
-        self._d = None
+        # Row width: learned from the first ingested row, or pinned up
+        # front via ``d``. Wire-facing deployments SHOULD pin it — it is
+        # what lets push_frame bound a sparse frame's claimed dense size
+        # BEFORE the scatter allocates (see push_frame).
+        if d is not None and int(d) < 1:
+            raise ValueError(f"row width d must be >= 1, got {d}")
+        self._d = int(d) if d is not None else None
         self._keep = np.ones(self.n, np.float32) if self._audit else None
         # Per bucketing level: a PREALLOCATED contiguous wave buffer
         # (allocated lazily once d is known) + the pending rows' client
@@ -670,10 +676,28 @@ class StreamingAggregator:
     def push_frame(self, buf):
         """Ingest one typed wire frame (utils/wire.py). A frame that fails
         the codec raises WireError — ban evidence for the caller, exactly
-        like the cluster quorum paths."""
+        like the cluster quorum paths.
+
+        Once the row width is known (the ctor's ``d``, or the first
+        ingested row) it pins the frame's element count, so a sparse
+        frame claiming a huge dense size rejects BEFORE the scatter
+        allocates (wire.decode's expect_elems). Before the width is
+        known, a sparse frame is refused outright: its dense size is a
+        bare header claim nothing here can corroborate, i.e. a
+        sender-controlled allocation — wire-facing deployments pass
+        ``d=`` at construction to accept a sparse first frame."""
         from ..utils import wire
 
-        return self.push(wire.decode(buf))
+        d = self._d
+        if d is None and wire.frame_scheme(buf) == "topk":
+            raise wire.WireError(
+                "sparse frame arrived before the reducer's row width is "
+                "known — its dense element count is an unverifiable "
+                "header claim (sender-controlled allocation); construct "
+                "the StreamingAggregator with d= to accept sparse first "
+                "frames"
+            )
+        return self.push(wire.decode(buf, expect_elems=d))
 
     def wire_transform(self, idx, payload):
         """``PeerExchange`` transform hook: decode + ingest in the waiter

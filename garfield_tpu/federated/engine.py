@@ -91,7 +91,7 @@ class ShardServer:
             self._red.reset()
         else:
             self._red = hierarchy.StreamingAggregator(
-                int(n), int(f), **self._cfg
+                int(n), int(f), d=self.d_shard, **self._cfg
             )
         self._round = int(round_)
         self.wire_bytes_in = 0
@@ -110,8 +110,13 @@ class ShardServer:
         stamp is under the CRC; DESIGN.md §19), not a silent mis-fold.
         A frame may carry several whole rows (k·d_shard elements): the
         fleet's clients batch their simulated cohort members into one
-        frame per shard per round."""
-        vec = wire.decode(buf, expect_plane=self.shard)
+        frame per shard per round — so the element count cannot be
+        pinned exactly, but it IS bounded by the whole cohort
+        (n·d_shard), and ``max_elems`` rejects a header claiming more
+        BEFORE a sparse frame's scatter allocates (the sparse dense-size
+        claim is otherwise sender-controlled, see wire.decode)."""
+        vec = wire.decode(buf, expect_plane=self.shard,
+                          max_elems=self._red.n * self.d_shard)
         if vec.size % self.d_shard:
             raise wire.WireError(
                 f"shard {self.shard} frame has {vec.size} elements — "

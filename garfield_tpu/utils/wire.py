@@ -22,10 +22,16 @@ symmetric grid per block; int4 packs biased nibbles (code + 8, so the
 honest grid is [1, 15] and nibble 0 is ban evidence). topk is
 sparsification — ``k`` little-endian ``(u32 index, f32 value)`` pairs
 with strictly-increasing indices ``< elems``. Every semantic violation
-(out-of-range scale, duplicate/descending/out-of-bounds index, nibble
-0) raises the same ``WireError`` as a CRC failure: the CRC proves the
-bytes are the sender's, so invalid *content* is attributable Byzantine
-evidence feeding the PR 4 quorum-exclusion ban path.
+(out-of-range scale, a block prefix past the element count, an int8
+code -128 / int4 nibble 0 outside the honest grid,
+duplicate/descending/out-of-bounds index) raises the same ``WireError``
+as a CRC failure: the CRC proves the bytes are the sender's, so invalid
+*content* is attributable Byzantine evidence feeding the PR 4
+quorum-exclusion ban path. The decoder never allocates more than
+O(elems) either — the block prefix is bounded by the element count and
+a sparse frame's claimed dense size must be pinned (``expect_elems``)
+or bounded (``max_elems``) by the consumer, so no CRC-valid frame can
+demand a multi-GB scatter or dequant pad.
 
 The dtype byte's high nibble is the **plane tag** (DESIGN.md §15): only
 two of its 256 values were ever used, so the spare bits carry which
@@ -264,7 +270,8 @@ def encode(vec, dtype=None, *, plane=0, k=None, keep_from=None,
     ``"topk"`` (round 18): the payload becomes ``k`` sorted
     ``(u32 index, f32 value)`` pairs — ``k`` explicit, or derived from
     the ``GARFIELD_WIRE_TOPK`` divisor (``DEFAULT_TOPK_DIV`` when
-    unset). ``keep_from`` marks the start of an always-kept dense tail
+    unset; an explicit ``k=0`` ships no head pairs — only the dense
+    tail rides). ``keep_from`` marks the start of an always-kept dense tail
     (the ``[grad || stats]`` frames' BatchNorm segment: state, not an
     additive signal — sparsifying it away would corrupt the robust-stats
     fold, so its coordinates ride along as ordinary pairs). int8/int4
@@ -289,6 +296,12 @@ def encode(vec, dtype=None, *, plane=0, k=None, keep_from=None,
         block = int(block)
         if block < 1:
             raise ValueError(f"quantization block must be >= 1, got {block}")
+        # Clamp the block to the vector: past vec.size it only grows the
+        # dequant pad (nblocks is 1 either way, so scales and codes — and
+        # therefore the decoded values — are identical), and the decoder
+        # rejects block > elems as an allocation bomb, so the clamp keeps
+        # every honest frame inside that bound.
+        block = min(block, max(vec.size, 1))
         qmax = 127 if dtype == "int8" else 7
         head, codes = _quant_payload(vec, qmax, block)
         if dtype == "int8":
@@ -314,7 +327,11 @@ def encode(vec, dtype=None, *, plane=0, k=None, keep_from=None,
             # demote real coordinates below garbage. Same honest-sender
             # loud-failure contract as the quantizers.
             raise ValueError("cannot top-k sparsify a non-finite vector")
-        if k >= head_n:
+        if k == 0:
+            # No head pairs — only the always-kept dense tail rides
+            # (argpartition with kth == head_n would be out of bounds).
+            idx = np.arange(head_n, vec.size, dtype=np.uint32)
+        elif k >= head_n:
             idx = np.arange(vec.size, dtype=np.uint32)
         else:
             top = np.argpartition(np.abs(vec[:head_n]), head_n - k)[
@@ -337,7 +354,7 @@ def encode(vec, dtype=None, *, plane=0, k=None, keep_from=None,
     ) + payload
 
 
-def decode(buf, *, expect_plane=None, expect_elems=None):
+def decode(buf, *, expect_plane=None, expect_elems=None, max_elems=None):
     """Decode a typed frame back to a float32 vector; raises WireError.
 
     Validation order matters for the ban path: header shape first (magic,
@@ -364,6 +381,14 @@ def decode(buf, *, expect_plane=None, expect_elems=None):
     their plane's d and MUST pass it (``cluster._frame_transform``
     does); the mismatch rejects BEFORE any allocation, as the same
     attributable WireError as the old wrong-length frame.
+
+    ``max_elems`` is the inexact form of the same pin, for consumers
+    whose frames legitimately vary in size (the federated shard plane's
+    multi-row frames: any whole number of rows up to the cohort) — a
+    header claiming more than the bound rejects before any allocation.
+    Every Byzantine-facing decode site must pass one of the two: a
+    sparse frame decoded with neither is an unbounded allocation the
+    sender controls.
     """
     if len(buf) < HEADER_NBYTES:
         raise WireError(
@@ -390,6 +415,11 @@ def decode(buf, *, expect_plane=None, expect_elems=None):
         raise WireError(
             f"frame promises {elems} elements, consumer expected "
             f"{int(expect_elems)}"
+        )
+    if max_elems is not None and elems > int(max_elems):
+        raise WireError(
+            f"frame promises {elems} elements, past the consumer's "
+            f"bound of {int(max_elems)}"
         )
     payload = buf[HEADER_NBYTES:]
     # Structural length checks come BEFORE the CRC (cheap, and a
@@ -432,6 +462,16 @@ def decode(buf, *, expect_plane=None, expect_elems=None):
         block = int(np.frombuffer(payload, "<u4", count=1)[0])
         if block < 1:
             raise WireError(f"quantization block {block} must be >= 1")
+        if block > max(int(elems), 1):
+            # An honest encoder clamps its block to the vector (same
+            # values, see encode); a larger block is an allocation bomb —
+            # the dequant pad is nblocks*block elements, which a
+            # block=0xFFFFFFFF prefix on a tiny frame turns into ~17 GB.
+            # This bound keeps it under 2x elems.
+            raise WireError(
+                f"quantization block {block} exceeds the frame's "
+                f"{elems} elements"
+            )
         nblocks = -(-int(elems) // block) if elems else 0
         codes_nbytes = (
             int(elems) if tag == _TAG_INT8 else (int(elems) + 1) // 2
@@ -454,6 +494,13 @@ def decode(buf, *, expect_plane=None, expect_elems=None):
         raw = np.frombuffer(payload, np.uint8, offset=4 + nblocks * 4)
         if tag == _TAG_INT8:
             codes = raw.view(np.int8)
+            if codes.size and (codes == -128).any():
+                # The symmetric grid is [-127, 127] (encode clips at
+                # qmax): code -128 is unreachable by any honest encoder
+                # — ban evidence exactly like int4's nibble 0.
+                raise WireError(
+                    "int8 code -128 is outside the symmetric grid"
+                )
         else:
             nib = np.empty(raw.size * 2, np.uint8)
             nib[0::2] = raw & 0x0F

@@ -326,6 +326,40 @@ class TestEngine:
         with pytest.raises(wire.WireError, match="whole number"):
             sv.push_frame(wire.encode(np.ones(7, np.float32), plane=1))
 
+    def test_shard_server_bounds_sparse_elems_claim(self):
+        """REVIEW fix: a cohort member's CRC-valid topk frame claiming a
+        huge dense size must reject on the shard's n*d_shard bound
+        BEFORE the scatter allocates (np.zeros(elems) at 2^40 is a 4 TB
+        allocation the sender controls) — same attributable WireError
+        ban path as a cross-shard stamp. Honest sparse frames inside
+        the bound still ingest."""
+        import struct
+        import zlib
+
+        spec = fed.plan_shards(32, 2)
+        sv = fed.ShardServer(1, spec, bucket_gar="average")
+        sv.begin_round(0, 4, 0)
+        pairs = np.zeros(2, np.dtype([("i", "<u4"), ("v", "<f4")]))
+        pairs["i"] = [0, 1]
+        pairs["v"] = [3.0, -3.0]
+        payload = pairs.tobytes()
+        giant = struct.pack(
+            "!2sBBQI", b"GW", 1, (1 << 4) | 4, 2 ** 40,
+            zlib.crc32(payload),
+        ) + payload
+        with pytest.raises(wire.WireError, match="bound"):
+            sv.push_frame(giant)
+        assert sv.arrived() == 0
+        # An honest multi-row sparse frame (4 rows x d_shard=16 = 64
+        # elems, exactly the bound) ingests fine.
+        rows = RNG.normal(size=(4, 32)).astype(np.float32)
+        sliced = spec.slice_rows(rows, 1)
+        sv.push_frame(
+            wire.encode(sliced.ravel(), "topk", k=64, plane=1)
+        )
+        assert sv.arrived() == 4
+        assert sv.finish_round().shape == (16,)
+
 
 # ---------------------------------------------------------------------------
 # suspicion survives sampling (ISSUE 13 satellite)

@@ -239,6 +239,43 @@ def test_wire_transform_rejects_are_ban_evidence():
     assert red.finalize().shape == (16,)
 
 
+def test_sparse_frames_need_a_known_row_width():
+    """REVIEW fix: push_frame must never scatter a sparse frame whose
+    claimed dense size nothing corroborates. Before the row width is
+    known a topk frame is refused outright (WireError — the caller's
+    ban path, not an OOM); with the width pinned at construction
+    (``d=``), honest sparse frames ingest and a CRC-valid forged frame
+    claiming 2^40 elements rejects before the scatter allocates."""
+    import struct
+    import zlib
+
+    v = np.arange(16, dtype=np.float32)
+    red = hierarchy.StreamingAggregator(8, 0, bucket_gar="median",
+                                        bucket_size=4)
+    with pytest.raises(wire.WireError, match="row width"):
+        red.push_frame(wire.encode(v, "topk", k=4))
+    assert red._arrived == 0  # the refused frame consumed no slot
+    red = hierarchy.StreamingAggregator(8, 0, bucket_gar="median",
+                                        bucket_size=4, d=16)
+    assert red.push_frame(wire.encode(v, "topk", k=4)) == 0
+    pairs = np.zeros(2, np.dtype([("i", "<u4"), ("v", "<f4")]))
+    pairs["i"] = [0, 1]
+    pairs["v"] = [5.0, -5.0]
+    payload = pairs.tobytes()
+    giant = struct.pack(
+        "!2sBBQI", b"GW", 1, 4, 2 ** 40, zlib.crc32(payload)
+    ) + payload
+    with pytest.raises(wire.WireError, match="expected"):
+        red.push_frame(giant)
+    # The pinned width also rejects wrong-size DENSE frames as codec
+    # (not contract) errors — attributable like any WireError.
+    with pytest.raises(wire.WireError):
+        red.push_frame(wire.encode(np.ones(9, np.float32)))
+    for row in np.zeros((7, 16), np.float32):
+        red.push(row)
+    assert red.finalize().shape == (16,)
+
+
 def test_streaming_contract_errors():
     red = hierarchy.StreamingAggregator(4, 0, bucket_gar="median",
                                         bucket_size=2)

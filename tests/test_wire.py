@@ -331,6 +331,72 @@ def test_int4_nibble_zero_rejected():
         wire.decode(_forge(3, v.size, bytes(payload)))
 
 
+def test_quantized_block_bomb_rejected_post_crc():
+    """A CRC-valid int8/int4 frame whose u32 block prefix dwarfs the
+    element count passes every length check (nblocks is 1 either way)
+    but would pad the dequant to nblocks*block f32 elements — ~17 GB at
+    block=0xFFFFFFFF — a receiver-side allocation bomb from an
+    attributable frame. The decoder bounds block by the element count
+    BEFORE dequantizing; honest encoders clamp, so every honest frame
+    sits inside the bound."""
+    v = np.ones(8, np.float32)
+    for scheme, tag in (("int8", 2), ("int4", 3)):
+        honest = wire.encode(v, scheme)
+        # The honest frame's block prefix is clamped to the vector.
+        pfx = np.frombuffer(honest[wire.HEADER_NBYTES:], "<u4", count=1)
+        assert int(pfx[0]) == v.size
+        payload = bytearray(honest[wire.HEADER_NBYTES:])
+        payload[0:4] = np.array([0xFFFFFFFF], "<u4").tobytes()
+        with pytest.raises(wire.WireError, match="block"):
+            wire.decode(_forge(tag, v.size, bytes(payload)))
+        # One past the element count is already out.
+        payload[0:4] = np.array([v.size + 1], "<u4").tobytes()
+        with pytest.raises(wire.WireError, match="block"):
+            wire.decode(_forge(tag, v.size, bytes(payload)))
+
+
+def test_int8_code_minus_128_rejected():
+    """encode clips int8 codes to the symmetric [-127, 127] grid, so a
+    -128 byte is unreachable by any honest encoder — the same
+    'invalid content = attributable ban evidence' contract as int4's
+    nibble 0 (which already rejects)."""
+    v = np.ones(4, np.float32)
+    honest = wire.encode(v, "int8")
+    payload = bytearray(honest[wire.HEADER_NBYTES:])
+    payload[-1] = 0x80  # last code byte -> -128
+    with pytest.raises(wire.WireError, match="-128"):
+        wire.decode(_forge(2, v.size, bytes(payload)))
+
+
+def test_topk_k_zero_ships_dense_tail_only():
+    """An explicit k=0 is a clean edge, not a numpy argpartition bomb:
+    no head pairs ride — only the always-kept dense tail (if any)."""
+    v = np.arange(1.0, 11.0, dtype=np.float32)
+    frame = wire.encode(v, "topk", k=0)
+    assert len(frame) == wire.HEADER_NBYTES  # zero pairs
+    np.testing.assert_array_equal(wire.decode(frame), np.zeros(10))
+    tail = wire.encode(v, "topk", k=0, keep_from=8)
+    out = wire.decode(tail)
+    np.testing.assert_array_equal(out[8:], v[8:])
+    assert np.flatnonzero(out[:8]).size == 0
+
+
+def test_decode_max_elems_bounds_sparse_claims():
+    """``max_elems``: the inexact consumer pin for variable-size frames
+    (the federated shard plane's whole-number-of-rows frames). A sparse
+    header claiming 2^40 elements rejects before the scatter allocates;
+    honest frames inside the bound pass, for every scheme."""
+    payload = _topk_payload([0, 1], [1.0, 2.0])
+    with pytest.raises(wire.WireError, match="bound"):
+        wire.decode(_forge(4, 2 ** 40, payload), max_elems=1 << 20)
+    assert wire.decode(_forge(4, 16, payload), max_elems=16).size == 16
+    v = np.ones(16, np.float32)
+    for scheme in wire.WIRE_SCHEMES:
+        assert wire.decode(wire.encode(v, scheme), max_elems=64).size == 16
+        with pytest.raises(wire.WireError, match="bound"):
+            wire.decode(wire.encode(v, scheme), max_elems=15)
+
+
 def test_sparse_index_attacks_rejected_post_crc():
     """Every malformed-sparse shape the ISSUE names, as CRC-valid forged
     frames: duplicate index (double-count), descending index, index out
