@@ -39,9 +39,10 @@ from ._common import (
     pairwise_distances,
     unflatten_vec,
 )
+from ..ops import coordinate as _coord
 
 
-def _selection_weight_matrix(dist, n, f, m, dtype):
+def _selection_weight_matrix(dist, n, f, m, dtype, use_sortnet=None):
     """Phase-1 selection as a (rounds, n) weight matrix.
 
     The selection loop only needs the (n, n) distance matrix: each round
@@ -50,19 +51,40 @@ def _selection_weight_matrix(dist, n, f, m, dtype):
     selected averages are then weight matmuls after the loop — the loop
     never touches the d-sized data, so the whole phase costs a single MXU
     pass over the stack (flat) or one matmul per leaf (tree).
+
+    Sortnet path (``use_sortnet=True``, n <= MAX_SORT_N): the round body's
+    row sort and stable argsort both run on the odd-even network —
+    bitwise-equal (same NaN-last total order, strict-< stable ties; the
+    masked matrix carries only finite values and +inf, never NaN). Unlike
+    krum, this is OPT-IN rather than env-default: the fori_loop re-sorts
+    the masked n x n matrix every round, so the network's O(n^2) exchange
+    rounds compound — SELBENCH_r01 measured it slower than the XLA sort
+    at every bucket size (265.61 vs 103.46 us/bucket at n=16, 7950.73 vs
+    1039.06 at n=32). GARFIELD_SORTNET_SELECT therefore does not reach
+    this loop; pass ``use_sortnet=True`` to A/B it (gar_bench --selection
+    does).
     """
     m_max = n - f - 2
     rounds = n - 2 * f - 2
+    sortnet = use_sortnet is True and n <= _coord.MAX_SORT_N
 
     def round_body(i, carry):
         active, weights = carry
         m_i = jnp.minimum(m, m_max - i)
         pair_ok = active[:, None] & active[None, :]
         masked = jnp.where(pair_ok, dist, jnp.inf)
-        csum = jnp.cumsum(jnp.sort(masked, axis=1), axis=1)
+        sorted_rows = (
+            _coord.sortnet_sort(masked, axis=1) if sortnet
+            else jnp.sort(masked, axis=1)
+        )
+        csum = jnp.cumsum(sorted_rows, axis=1)
         scores = jax.lax.dynamic_index_in_dim(csum, m_i - 1, axis=1, keepdims=False)
         scores = jnp.where(active, scores, jnp.inf)
-        order = jnp.argsort(scores)  # stable: ties break on lowest index
+        # stable: ties break on lowest index
+        order = (
+            _coord.sortnet_argsort(scores, axis=0) if sortnet
+            else jnp.argsort(scores)
+        )
         w = jnp.zeros((n,), dtype).at[order].set(
             (jnp.arange(n) < m_i).astype(dtype) / m_i
         )
@@ -76,7 +98,7 @@ def _selection_weight_matrix(dist, n, f, m, dtype):
     return weights
 
 
-def aggregate(gradients, f, m=None, **kwargs):
+def aggregate(gradients, f, m=None, use_sortnet=None, **kwargs):
     """Bulyan over Multi-Krum."""
     g = as_stack(gradients)
     n, d = g.shape
@@ -84,7 +106,7 @@ def aggregate(gradients, f, m=None, **kwargs):
         m = n - f - 2
     rounds = n - 2 * f - 2
     dist = pairwise_distances(g)  # (n, n), diag/non-finite -> +inf
-    weights = _selection_weight_matrix(dist, n, f, m, g.dtype)
+    weights = _selection_weight_matrix(dist, n, f, m, g.dtype, use_sortnet)
     # Rows never selected in any round must not poison the matmul with
     # NaN/Inf coordinates (0 * inf = nan); rows that are selected pass
     # through untouched (reference mean semantics).
@@ -122,7 +144,7 @@ def _select_and_phase2(stack, weights, treedef, shapes, beta):
     )
 
 
-def tree_aggregate(grads_tree, f, m=None, **kwargs):
+def tree_aggregate(grads_tree, f, m=None, use_sortnet=None, **kwargs):
     """Tree-mode Bulyan: concat-first.
 
     Unlike Krum (whose Gram + weighted-sum both decompose per leaf and fuse
@@ -141,11 +163,11 @@ def tree_aggregate(grads_tree, f, m=None, **kwargs):
     beta = rounds - 2 * f
     stack, shapes = concat_stack(leaves)
     dist = pairwise_distances(stack)
-    weights = _selection_weight_matrix(dist, n, f, m, jnp.float32)
+    weights = _selection_weight_matrix(dist, n, f, m, jnp.float32, use_sortnet)
     return _select_and_phase2(stack, weights, treedef, shapes, beta)
 
 
-def fold_aggregate(gram_p, apply_rows, f, m=None, **kwargs):
+def fold_aggregate(gram_p, apply_rows, f, m=None, use_sortnet=None, **kwargs):
     """Folded-attack Bulyan (parallel.fold): phase 1 runs on the poisoned
     Gram (a static remap of the raw extended Gram — the rows are never
     rewritten); ``apply_rows`` materializes the per-round selected averages
@@ -159,7 +181,7 @@ def fold_aggregate(gram_p, apply_rows, f, m=None, **kwargs):
     rounds = n - 2 * f - 2
     beta = rounds - 2 * f
     dist = distances_from_gram(gram_p)
-    weights = _selection_weight_matrix(dist, n, f, m, jnp.float32)
+    weights = _selection_weight_matrix(dist, n, f, m, jnp.float32, use_sortnet)
     selected, unflatten = apply_rows(weights)  # (rounds, d)
     return unflatten(ops.averaged_median_mean(selected, beta))
 

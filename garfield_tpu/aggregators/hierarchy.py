@@ -568,7 +568,7 @@ class StreamingAggregator:
 
     def __init__(self, n, f, *, bucket_gar="krum", top_gar=None,
                  bucket_size=None, levels="auto", wave_buckets=8,
-                 audit=False, telemetry=False, d=None):
+                 audit=False, telemetry=False, d=None, double_buffer=None):
         self.plan = plan_hierarchy(n, f, bucket_gar, top_gar, bucket_size,
                                    levels)
         self.n = int(n)
@@ -576,6 +576,22 @@ class StreamingAggregator:
         self.wave = max(1, int(wave_buckets))
         self._telemetry = bool(telemetry)
         self._audit = bool(audit) or self._telemetry
+        # Double-buffered wave fold (GARFIELD_HIER_DOUBLE_BUFFER, default
+        # on; ``double_buffer=`` overrides for the equality tests): each
+        # level keeps TWO wave buffers, a dispatched wave folds on device
+        # while ingest threads fill the other buffer, and the blocking
+        # summary readback moves to the next wave's dispatch (the swap
+        # point). Fold boundaries and cascade order are unchanged, so
+        # streaming==batch bitwise equality is untouched; the cost is one
+        # extra O(wave · bucket · d) buffer per level.
+        if double_buffer is None:
+            double_buffer = os.environ.get(
+                "GARFIELD_HIER_DOUBLE_BUFFER", "1"
+            ).lower() not in ("", "0", "false")
+        self._double = bool(double_buffer)
+        from ..utils import wire as _wire
+
+        self._fused = _wire.wire_fused()
         self._lock = threading.RLock()
         self._arrived = 0
         # Row width: learned from the first ingested row, or pinned up
@@ -593,7 +609,8 @@ class StreamingAggregator:
         # np.stack design: each ingest is one row memcpy and each fold
         # hands XLA one contiguous (take, size, d) view.
         self._levels = [
-            {"level": lv, "buf": None, "fill": 0, "spans": [], "cursor": 0}
+            {"level": lv, "bufs": [None, None], "active": 0,
+             "pending": None, "fill": 0, "spans": [], "cursor": 0}
             for lv in self.plan.bucket_levels
         ]
         self._final_rows = []
@@ -654,11 +671,14 @@ class StreamingAggregator:
                     self._final_spans.append((idx, idx + 1))
                 return first
             state = self._levels[0]
-            buf = self._buf_for(state)
-            cap = buf.shape[0]
             i = 0
             while i < k:
-                take = min(k - i, cap - state["fill"])
+                # Re-fetched EVERY iteration: the _drain below swaps the
+                # active buffer in double-buffer mode, so a cached ``buf``
+                # would keep writing rows into the buffer the in-flight
+                # wave aliases (caught by the streaming==batch pin).
+                buf = self._buf_for(state)
+                take = min(k - i, buf.shape[0] - state["fill"])
                 if take <= 0:  # full buffer with nothing drainable: bug
                     raise RuntimeError("level-0 wave buffer stalled")
                 fill = state["fill"]
@@ -685,7 +705,16 @@ class StreamingAggregator:
         known, a sparse frame is refused outright: its dense size is a
         bare header claim nothing here can corroborate, i.e. a
         sender-controlled allocation — wire-facing deployments pass
-        ``d=`` at construction to accept a sparse first frame."""
+        ``d=`` at construction to accept a sparse first frame.
+
+        Fused path (GARFIELD_WIRE_FUSED_DECODE, default on): once the
+        row width is known the frame dequantizes/scatters DIRECTLY into
+        the level-0 wave buffer slot it will occupy (wire.decode_into)
+        — no O(d) transient array per frame, one memory pass instead of
+        decode + memcpy. Identical bytes, identical validation: a
+        rejected frame raises BEFORE the first write, so the slot is
+        never claimed nor scribbled on, and the arrival index commits
+        only after the decode succeeds."""
         from ..utils import wire
 
         d = self._d
@@ -697,6 +726,23 @@ class StreamingAggregator:
                 "the StreamingAggregator with d= to accept sparse first "
                 "frames"
             )
+        if d is not None and self._fused and self._levels:
+            with self._lock:
+                if self._result is not None:
+                    raise RuntimeError("finalize() already ran")
+                if self._arrived >= self.n:
+                    raise ValueError(
+                        f"already ingested all {self.n} clients"
+                    )
+                state = self._levels[0]
+                row = self._buf_for(state)[state["fill"]]
+                wire.decode_into(buf, row, expect_elems=d)
+                idx = self._arrived
+                self._arrived += 1
+                state["fill"] += 1
+                state["spans"].append((idx, idx + 1))
+                self._drain(0, flush=False)
+                return idx
         return self.push(wire.decode(buf, expect_elems=d))
 
     def wire_transform(self, idx, payload):
@@ -726,14 +772,16 @@ class StreamingAggregator:
         return idx
 
     def _buf_for(self, state):
-        if state["buf"] is None:
+        i = state["active"]
+        if state["bufs"][i] is None:
             # One wave of the level's largest buckets plus spill room for
             # the partially-filled next bucket — folds trigger the moment
             # a wave (or a size-run tail) completes, so fill never
-            # exceeds this.
+            # exceeds this. The second buffer (double-buffer mode only)
+            # allocates lazily on the first swap.
             cap = (self.wave + 1) * max(state["level"].sizes)
-            state["buf"] = np.empty((cap, self._d), np.float32)
-        return state["buf"]
+            state["bufs"][i] = np.empty((cap, self._d), np.float32)
+        return state["bufs"][i]
 
     def _ingest(self, lvl_idx, row, span):
         if lvl_idx == len(self._levels):
@@ -764,6 +812,11 @@ class StreamingAggregator:
                 state["fill"] = 0
                 state["spans"] = []
                 state["cursor"] = 0
+                # A dropped in-flight wave only READS its buffer; its
+                # result is never consumed, so the fresh round may refill
+                # immediately.
+                state["pending"] = None
+                state["active"] = 0
             self._final_rows = []
             self._final_spans = []
 
@@ -803,56 +856,108 @@ class StreamingAggregator:
             if take == 0:
                 break
             used = take * size
-            buf = state["buf"]
+            buf = self._buf_for(state)
             spans = state["spans"][:used]
             del state["spans"][:used]
             # jnp.asarray of an aligned f32 numpy array is ZERO-COPY on
-            # the CPU backend (the stack aliases ``buf``) — safe here
-            # ONLY because the ``np.asarray(out)`` readback below blocks
-            # until the fold finishes, and the buffer is not shifted or
-            # refilled until after that. (Same aliasing gar_bench's
-            # donation chain has to defend against; here it is the free
-            # H2D we want.)
-            # Trace span (schema v5): one per vmapped wave fold — the
-            # streaming reducer's unit of device work, so the report can
-            # attribute ingest wall clock to fold vs wire time.
+            # the CPU backend (the stack aliases ``buf``) — safe ONLY
+            # because the ``np.asarray(out)`` readback blocks before the
+            # buffer is shifted or refilled. Sync mode blocks right here;
+            # double-buffer mode moves the block to the NEXT wave's
+            # dispatch (``_complete_pending`` below, the swap point), so
+            # the fold overlaps the ingest threads filling the other
+            # buffer. (Same aliasing gar_bench's donation chain has to
+            # defend against; here it is the free H2D we want.)
+            # Trace spans (schema v5/v12): hier_h2d is the staging of one
+            # wave, hier_wave its dispatch (+ readback in sync mode) —
+            # the report attributes ingest wall clock to fold vs wire vs
+            # staging time.
             with _trace.span("hier_wave", level=int(lvl_idx),
                              buckets=int(take), size=int(size)):
-                stack = jnp.asarray(buf[:used].reshape(take, size, -1))
+                with _trace.span("hier_h2d", level=int(lvl_idx),
+                                 buckets=int(take), size=int(size)):
+                    stack = jnp.asarray(buf[:used].reshape(take, size, -1))
                 fn = _wave_jit(level.rule, level.f, self._audit)
                 if self._audit:
                     out, w = fn(stack)
-                    w = np.asarray(w)
                 else:
-                    out = fn(stack)
-                # blocks: summaries host-side, frees buf
-                out = np.asarray(out)
+                    out, w = fn(stack), None
+                if not self._double:
+                    # blocks: summaries host-side, frees buf
+                    out = np.asarray(out)
+                    if w is not None:
+                        w = np.asarray(w)
             del stack
-            # Shift the spill (the partially-filled next bucket) to the
-            # buffer front; at most one bucket's worth, so the copy is
-            # negligible next to the fold it unblocks.
+            # The dispatched buckets leave the level's accounting NOW —
+            # ``_ready`` must see the cursor past them whether or not
+            # their summaries have landed host-side yet.
+            state["cursor"] += take
             left = state["fill"] - used
-            if left:
-                buf[:left] = buf[used:state["fill"]].copy()
-            state["fill"] = left
-            excluded = 0
-            for b in range(take):
-                members = spans[b * size:(b + 1) * size]
-                if self._audit:
-                    for j, (a, bb) in enumerate(members):
-                        if w[b, j] == 0:
-                            self._keep[a:bb] = 0.0
-                            excluded += 1
-                bspan = (members[0][0], members[-1][1])
-                state["cursor"] += 1
-                self._ingest(lvl_idx + 1, out[b], bspan)
-            if self._telemetry:
-                from ..telemetry import hub as _hub
+            if self._double:
+                # Swap point: the previous wave's readback must land
+                # before the buffer it aliased (the one this wave's spill
+                # moves into) is written again — the sync invariant, one
+                # wave later. Completing FIRST also keeps the cascade in
+                # bucket order, which is what pins streaming==batch.
+                self._complete_pending(lvl_idx)
+                state["pending"] = {"out": out, "w": w, "spans": spans,
+                                    "take": take, "size": size}
+                state["active"] ^= 1
+                other = self._buf_for(state)
+                # Shift the spill (the partially-filled next bucket) into
+                # the OTHER buffer — the dispatched wave still aliases
+                # ``buf``, which is only read from here on.
+                if left:
+                    other[:left] = buf[used:state["fill"]]
+                state["fill"] = left
+            else:
+                # Shift the spill to the buffer front; at most one
+                # bucket's worth, so the copy is negligible next to the
+                # fold it unblocks.
+                if left:
+                    buf[:left] = buf[used:state["fill"]].copy()
+                state["fill"] = left
+                self._cascade(lvl_idx, out, w, spans, take, size)
+        if flush:
+            self._complete_pending(lvl_idx)
 
-                _hub.emit_event(
-                    "hier_wave", level=lvl_idx, buckets=int(take),
-                    size=int(size), excluded_members=int(excluded),
-                )
+    def _complete_pending(self, lvl_idx):
+        """Block on the in-flight wave's summary readback and cascade it —
+        the double-buffer swap point. The buffer the wave aliased is free
+        for refill the moment this returns. No-op in sync mode (nothing is
+        ever pending) or when no wave is in flight."""
+        state = self._levels[lvl_idx]
+        p, state["pending"] = state["pending"], None
+        if p is None:
+            return
+        with _trace.span("hier_fold_wait", level=int(lvl_idx),
+                         buckets=int(p["take"]), size=int(p["size"])):
+            out = np.asarray(p["out"])
+            w = np.asarray(p["w"]) if p["w"] is not None else None
+        self._cascade(lvl_idx, out, w, p["spans"], p["take"], p["size"])
+
+    def _cascade(self, lvl_idx, out, w, spans, take, size):
+        """Host-side tail of one completed wave: audit bookkeeping and the
+        summary cascade into the next level (identical for the sync and
+        double-buffered paths — completion order is bucket order in both,
+        so the upper levels see the exact same ingest sequence)."""
+        excluded = 0
+        for b in range(take):
+            members = spans[b * size:(b + 1) * size]
+            if self._audit:
+                for j, (a, bb) in enumerate(members):
+                    if w[b, j] == 0:
+                        self._keep[a:bb] = 0.0
+                        excluded += 1
+            bspan = (members[0][0], members[-1][1])
+            self._ingest(lvl_idx + 1, out[b], bspan)
+        if self._telemetry:
+            from ..telemetry import hub as _hub
+
+            _hub.emit_event(
+                "hier_wave", level=lvl_idx, buckets=int(take),
+                size=int(size), excluded_members=int(excluded),
+            )
 
     def finalize(self):
         """Flush every level, run the final fold, return the (d,) numpy

@@ -12,6 +12,7 @@ inside the surrounding jit'd train step.
 """
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -26,36 +27,88 @@ from ._common import (
     tree_gram,
     tree_weighted_sum,
 )
+from ..ops import coordinate as _coord
 
 
-def _scores_from_dist(dist, n, f):
+def _sortnet_select(use_sortnet=None):
+    """Whether the fast selection path is on: explicit override wins, else
+    the ``GARFIELD_SORTNET_SELECT`` knob (default ON). Read at TRACE time —
+    callers that bench both paths (gar_bench --selection) must pass the
+    override explicitly so each impl gets its own jit closure instead of
+    poisoning a shared cache with an env read."""
+    if use_sortnet is not None:
+        return bool(use_sortnet)
+    return os.environ.get("GARFIELD_SORTNET_SELECT", "1").lower() not in (
+        "", "0", "false",
+    )
+
+
+def _scores_from_dist(dist, n, f, use_sortnet=None):
     """Krum score of row i = sum of its n-f-1 smallest distances to the
     other rows (krum.py:55-63). The single source of the score formula —
     the flat path, the tree path, and selection_indices all go through it,
     so the trajectory-equality the tests assert cannot silently break.
+
+    Fast path (GARFIELD_SORTNET_SELECT, default on): the full row sort is
+    never materialized. n <= MAX_SORT_N runs the odd-even network's
+    k-smallest-sum (``sortnet_row_sums`` — one batched network under the
+    hierarchy's vmapped wave instead of per-bucket XLA variadic sorts);
+    larger n reduces via negated ``lax.top_k`` (negation is exact; dist
+    has no NaN — diag and non-finite entries are +inf). EVERY path sums
+    its k ascending values as an explicit add chain: a chain's order is
+    fixed (XLA never reassociates float adds) where an axis ``jnp.sum``
+    may regroup per fusion context, so the on/off paths see identical
+    operands in identical order — same scores bitwise, the trajectory pin
+    tests/test_gars.py asserts.
     """
+    k = n - f - 1
+
+    def _chain(cols):
+        acc = cols[0]
+        for i in range(1, k):
+            acc = acc + cols[i]
+        return acc
+
+    if _sortnet_select(use_sortnet):
+        if n <= _coord.MAX_SORT_N:
+            return _coord.sortnet_row_sums(dist, k, axis=1)
+        neg, _ = jax.lax.top_k(-dist, k)  # k smallest, ascending after -
+        return _chain([-neg[:, i] for i in range(k)])
     sorted_d = jnp.sort(dist, axis=1)
-    return jnp.sum(sorted_d[:, : n - f - 1], axis=1)
+    return _chain([sorted_d[:, i] for i in range(k)])
 
 
-def _selection_weights_from_dist(dist, n, f, m):
+def _selection_weights_from_dist(dist, n, f, m, use_sortnet=None):
     """One-hot/m weight vector over the m best-scored rows (stable ties) —
-    the masked matvec form of ``mean(g[sel])`` (see ``aggregate``)."""
-    sel = jnp.argsort(_scores_from_dist(dist, n, f))[:m]
+    the masked matvec form of ``mean(g[sel])`` (see ``aggregate``). On the
+    fast path at n <= MAX_SORT_N the m best indices come from the
+    index-carrying network (``sortnet_top_m``), which reproduces the
+    stable-argsort prefix bitwise (strict-< network: ties keep ascending
+    index order); above the bound the stable argsort stays — ``top_k``'s
+    tie order is not contractually stable, and flat n > 32 selection is
+    off the critical path (the hierarchy folds buckets of <= 32)."""
+    scores = _scores_from_dist(dist, n, f, use_sortnet)
+    if _sortnet_select(use_sortnet) and n <= _coord.MAX_SORT_N:
+        sel = _coord.sortnet_top_m(scores, m, axis=0)
+    else:
+        sel = jnp.argsort(scores)[:m]
     return jnp.zeros((n,), jnp.float32).at[sel].set(1.0 / m)
 
 
-def selection_indices(gradients, f, m=None):
+def selection_indices(gradients, f, m=None, use_sortnet=None):
     """Indices of the m best-scored gradients, best first (stable ties)."""
     g = as_stack(gradients)
     n = g.shape[0]
     if m is None:
         m = n - f - 2
     dist = pairwise_distances(g)  # (n, n), diag/non-finite -> +inf
-    return jnp.argsort(_scores_from_dist(dist, n, f))[:m]
+    scores = _scores_from_dist(dist, n, f, use_sortnet)
+    if _sortnet_select(use_sortnet) and n <= _coord.MAX_SORT_N:
+        return _coord.sortnet_top_m(scores, m, axis=0)
+    return jnp.argsort(scores)[:m]
 
 
-def aggregate(gradients, f, m=None, **kwargs):
+def aggregate(gradients, f, m=None, use_sortnet=None, **kwargs):
     """Multi-Krum: average of the m best-scored gradients.
 
     The average is computed as a one-hot weight matvec ``w @ g`` rather than
@@ -69,7 +122,7 @@ def aggregate(gradients, f, m=None, **kwargs):
     if m is None:
         m = n - f - 2
     w = _selection_weights_from_dist(
-        pairwise_distances(g), n, f, m
+        pairwise_distances(g), n, f, m, use_sortnet
     ).astype(g.dtype)
     # Zero-weight rows must not poison the matvec with NaN/Inf coordinates
     # (0 * inf = nan); selected rows pass through untouched, preserving the
@@ -78,7 +131,7 @@ def aggregate(gradients, f, m=None, **kwargs):
     return w @ gz
 
 
-def tree_aggregate(grads_tree, f, m=None, **kwargs):
+def tree_aggregate(grads_tree, f, m=None, use_sortnet=None, **kwargs):
     """Tree-mode Multi-Krum: no (n, d) flat stack.
 
     The pairwise distances need only the Gram matrix, which is the sum of
@@ -91,18 +144,23 @@ def tree_aggregate(grads_tree, f, m=None, **kwargs):
     if m is None:
         m = n - f - 2
     dist = distances_from_gram(tree_gram(grads_tree))
-    w = _selection_weights_from_dist(dist, n, f, m)
+    w = _selection_weights_from_dist(dist, n, f, m, use_sortnet)
     return tree_weighted_sum(grads_tree, w)
 
 
-def gram_select(gram, f, m=None, **kwargs):
+def gram_select(gram, f, m=None, use_sortnet=None, **kwargs):
     """Selection weights from a (possibly attack-remapped) Gram matrix —
     the Gram-form interface behind the folded attack path (parallel.fold):
-    ``aggregate(stack) == gram_select(stack @ stack.T) @ stack``."""
+    ``aggregate(stack) == gram_select(stack @ stack.T) @ stack``. Under the
+    hierarchy's vmapped wave fold this is where the batched selection
+    network lands: one network over the whole (W, s, s) wave instead of W
+    per-bucket XLA sorts."""
     n = gram.shape[0]
     if m is None:
         m = n - f - 2
-    return _selection_weights_from_dist(distances_from_gram(gram), n, f, m)
+    return _selection_weights_from_dist(
+        distances_from_gram(gram), n, f, m, use_sortnet
+    )
 
 
 def check(gradients, f, m=None, **kwargs):

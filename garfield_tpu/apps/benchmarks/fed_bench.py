@@ -92,12 +92,61 @@ def _spawn_env():
 # --- the shard child (one PS shard process of one scaling cell) -------------
 
 
+# Span-name -> artifact phase-name map for the child's per-phase digest
+# (schema v12): the trace plane's hierarchy spans keep their producer
+# names in the JSONL stream; the fed_bench row speaks the ISSUE's
+# vocabulary (ingest/h2d/fold/selection).
+_PHASE_NAMES = {
+    "ingest": "ingest",          # one push_rows wave (decode-free path)
+    "hier_h2d": "h2d",           # staging one wave onto the device
+    "hier_wave": "fold",         # wave dispatch (+ readback in sync mode)
+    "hier_fold_wait": "fold_wait",  # double-buffer blocking readback
+    "hier_finalize": "finalize",
+    "selection": "selection",    # the Gram-selection micro-probe below
+}
+
+
+def _selection_probe(server, wave, reps=24):
+    """Emit ``selection`` spans: the bucket rule's Gram selection at the
+    deployed level-0 bucket size, timed on a wave-shaped batch. The
+    selection runs FUSED inside the wave fold program (that fusion is
+    the point of the sortnet path), so it cannot be timed in situ — the
+    probe times the selection subgraph alone (Gram matmul + ranked
+    pick), gar_bench --selection's methodology at this cell's exact
+    (rule, bucket_size, d_shard). Median buckets have no selection
+    phase; their rows simply omit it."""
+    red = server._red
+    if red is None or not red._levels:
+        return
+    level = red._levels[0]["level"]
+    if level.rule not in ("krum", "bulyan"):
+        return
+    import jax
+
+    from ...telemetry import trace as tele_trace
+    from .gar_bench import _selection_fn
+
+    s = max(level.sizes)
+    g = jax.random.normal(jax.random.PRNGKey(7), (wave, s, server.d_shard))
+    fn = jax.jit(_selection_fn(level.rule, level.f, True))
+    jax.block_until_ready(fn(g))  # compile + warm outside the spans
+    for _ in range(reps):
+        with tele_trace.span("selection", buckets=int(wave), size=int(s)):
+            jax.block_until_ready(fn(g))
+
+
 def _shard_run(args):
     """One shard process of one scaling cell: sample the cohort, ingest
     its own column span of every cohort row, fold, encode the broadcast
     frame. Prints one JSON line the parent aggregates. The first round
-    is a warmup (fold-program compiles) and is not reported."""
+    is a warmup (fold-program compiles) and is not reported. The child
+    installs a private MetricsHub + trace for the timed rounds, so the
+    line carries per-phase p50/p95 (schema v12): ingest waves, H2D
+    staging, wave fold dispatch/readback, and the selection micro-probe
+    (see _selection_probe)."""
     from ... import federated as fed
+    from ...telemetry import hub as tele_hub
+    from ...telemetry import trace as tele_trace
 
     spec = fed.plan_shards(args.d, args.shards)
     s = args.shard_index
@@ -112,8 +161,15 @@ def _shard_run(args):
     wave_rows = args.wave * 32
     pools = [rng.normal(size=(wave_rows, args.d)).astype(np.float32)
              for _ in range(2)]
+    hub = tele_hub.MetricsHub()
     walls, bytes_out = [], 0
     for r in range(args.rounds + 1):  # +1: round 0 is compile warmup
+        if r == 1:
+            # Arm the phase digest AFTER the warmup round: round 0's
+            # compile-dominated spans would pollute the tails the
+            # artifact commits.
+            tele_hub.install(hub)
+            tele_trace.enable(who=f"fed-shard-{s}")
         t0 = time.perf_counter()
         cohort = sampler.cohort(r)
         server.begin_round(r, cohort.size, f)
@@ -121,17 +177,28 @@ def _shard_run(args):
         while i < cohort.size:
             pool = pools[(i // wave_rows) % 2]
             take = min(wave_rows, cohort.size - i)
-            server.push_rows(spec.slice_rows(pool[:take], s))
+            with tele_trace.span("ingest", round=r, rows=int(take)):
+                server.push_rows(spec.slice_rows(pool[:take], s))
             i += take
         agg = server.finish_round()
         frame = wire.encode(agg, plane=s)  # the shard broadcast payload
         bytes_out = len(frame)
         if r > 0:
             walls.append(time.perf_counter() - t0)
+    _selection_probe(server, args.wave)
+    phases = {
+        _PHASE_NAMES.get(ph, ph): {
+            "count": int(st["count"]),
+            "p50_s": round(st["p50_s"], 9),
+            "p95_s": round(st["p95_s"], 9),
+        }
+        for ph, st in (hub.phase_stats() or {}).items()
+    }
     print(json.dumps({
         "shard": s, "walls": [round(w, 4) for w in walls],
         "f_budget": f, "d_shard": spec.width(s),
         "broadcast_bytes": bytes_out, "peak_rss_bytes": _rss(),
+        "phases": phases or None,
     }), flush=True)
 
 
@@ -161,7 +228,12 @@ def scaling_cell(args, gar, shards):
         reports.append(json.loads(out.strip().splitlines()[-1]))
     per_shard_s = [min(r["walls"]) for r in reports]
     round_s = max(per_shard_s)
+    # The row's per-phase attribution (schema v12) is the BOTTLENECK
+    # shard's digest — the shard whose wall defines round_s is the one
+    # whose phase breakdown explains it.
+    phases = reports[per_shard_s.index(round_s)].get("phases")
     return {
+        **({"phases": phases} if phases else {}),
         "check": "scaling", "n": args.n, "population": args.population,
         "d": args.d, "shards": shards, "gar": f"hier-{gar}",
         "f": reports[0]["f_budget"], "rounds": args.rounds,

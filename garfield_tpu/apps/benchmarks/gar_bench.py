@@ -25,7 +25,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ... import aggregators
+from ...aggregators import bulyan as _bulyan
 from ...aggregators import hierarchy
+from ...aggregators import krum as _krum
+from ...aggregators._common import distances_from_gram
 from ...utils import profiling
 from ..common import peak_rss_bytes
 
@@ -181,6 +184,157 @@ def hier_bench_one(name, n, f, d, *, bucket_size, wave, trials, seed=0):
     }
 
 
+# Selection micro mode (--selection): the Gram-rule selection step in
+# isolation, batched across a wave of buckets exactly as the hierarchy's
+# vmapped fold runs it. Both impls are explicit ``use_sortnet`` closures
+# — NOT the env knob — so each gets its own jit program and the shared
+# cache is never poisoned by a trace-time env read (see
+# krum._sortnet_select).
+SELECTION_RULES = ("krum", "bulyan")
+SELECTION_IMPLS = ("sortnet", "xla_sort")
+
+
+def _selection_fn(rule, f, use_sortnet):
+    """(W, s, d) wave -> per-bucket selection weights, the Gram rule's
+    selection step only (Gram matmul + scores + ranked pick). Krum emits
+    (W, s) one-hot/m weights; Bulyan its (W, rounds, s) phase-1 weight
+    matrix — in both cases exactly what the wave fold consumes."""
+    if rule == "krum":
+        def one(gb):
+            acc = jnp.promote_types(gb.dtype, jnp.float32)
+            gram = jnp.matmul(gb, gb.T, preferred_element_type=acc)
+            return _krum.gram_select(gram, f, use_sortnet=use_sortnet)
+    elif rule == "bulyan":
+        def one(gb):
+            s = gb.shape[0]
+            acc = jnp.promote_types(gb.dtype, jnp.float32)
+            gram = jnp.matmul(gb, gb.T, preferred_element_type=acc)
+            return _bulyan._selection_weight_matrix(
+                distances_from_gram(gram), s, f, s - f - 2, jnp.float32,
+                use_sortnet,
+            )
+    else:
+        raise ValueError(
+            f"--selection supports {SELECTION_RULES}, got {rule!r}"
+        )
+    return jax.vmap(one)
+
+
+def selection_bench_one(rule, s, f, d, wave, reps, key, trials, impl):
+    """Time one (rule, bucket_size, impl) selection cell: a jitted
+    dependency-chained wave of ``wave`` buckets of ``s`` rows, selection
+    weights consumed through the softsign DCE guard and written back
+    into the stack (the bench_one methodology verbatim — paired reps,
+    adaptive sizing, min over trials)."""
+    g = jax.random.normal(key, (wave, s, d), jnp.float32)
+    sel = _selection_fn(rule, f, impl == "sortnet")
+
+    def _chain(stack):
+        w = sel(stack).astype(jnp.float32)
+        # Reduce whatever weight shape the rule emits to one scalar per
+        # bucket through the nonlinear guard — every weight is a real
+        # data dependency of the next iteration's stack.
+        guarded = w * jax.lax.rsqrt(1.0 + w * w)
+        per_bucket = guarded.reshape(wave, -1).sum(axis=1)
+        return stack.at[:, 0, 0].add(per_bucket * 1e-6)
+
+    chain = jax.jit(_chain, donate_argnums=0)
+    s0_host = np.array(chain(g))  # compile + warm + sync (g donated)
+
+    def timed(k):
+        st = jnp.array(s0_host)
+        np.asarray(st[0, :1, :1])  # finish H2D + drain queue
+        t0 = time.perf_counter()
+        for _ in range(k):
+            st = chain(st)
+        np.asarray(st[0, :1, :1])  # host readback sync
+        return time.perf_counter() - t0
+
+    est = profiling.paired_reps(timed, reps, pairs=2)
+    if est is not None and est * reps < 0.25:
+        reps = min(4000, max(reps, int(0.5 / max(est, 1e-7))))
+    vals = [
+        profiling.paired_reps(timed, reps, pairs=4, agg="min")
+        for _ in range(max(1, trials))
+    ]
+    vals = [v for v in vals if v is not None]
+    return min(vals) if vals else None
+
+
+def _selection_main(args):
+    """The --selection sweep: (rule x bucket_size x impl) grid, JSON +
+    schema-versioned JSONL twin like the other modes."""
+    from ...ops import coordinate as _coord
+
+    rules = args.gars or list(SELECTION_RULES)
+    sizes = args.sel_buckets or [8, 16, 32]
+    ds = args.ds or [256]
+    wave = args.hier_wave
+    key = jax.random.PRNGKey(0)
+    results = []
+    for rule in rules:
+        for s in sorted(sizes):
+            f = max_f(rule, s) if args.f_mode == "max" else min(
+                1, max_f(rule, s))
+            for d in ds:
+                for impl in SELECTION_IMPLS:
+                    if impl == "sortnet" and s > _coord.MAX_SORT_N:
+                        continue  # the network is bounded; xla row stays
+                    key, sub = jax.random.split(key)
+                    try:
+                        latency = selection_bench_one(
+                            rule, s, f, d, wave, args.reps, sub,
+                            args.trials, impl,
+                        )
+                    except Exception as exc:
+                        print(f"{rule} s={s} f={f} impl={impl}: SKIP "
+                              f"({exc})", file=sys.stderr)
+                        continue
+                    row = {"gar": rule, "n": s, "f": f, "d": d,
+                           "grid": "selection", "impl": impl,
+                           "wave_buckets": wave,
+                           "latency_s": latency,
+                           "per_bucket_s": (None if latency is None
+                                            else latency / wave),
+                           "trials": args.trials,
+                           "dce_guard": "softsign",
+                           "peak_rss_bytes": peak_rss_bytes()}
+                    if latency is None:
+                        row["below_noise_floor"] = True
+                        print(f"{rule:>8} s={s:<3} f={f:<3} d={d:<5} "
+                              f"impl={impl:<9} below noise floor",
+                              flush=True)
+                    else:
+                        print(f"{rule:>8} s={s:<3} f={f:<3} d={d:<5} "
+                              f"impl={impl:<9} "
+                              f"{latency * 1e6:9.1f} us/wave  "
+                              f"{latency / wave * 1e6:8.2f} us/bucket",
+                              flush=True)
+                    results.append(row)
+    if args.json:
+        with open(args.json, "w") as fp:
+            json.dump(results, fp, indent=1)
+        import os
+
+        from ...telemetry import exporters
+
+        jsonl_path = os.path.splitext(args.json)[0] + ".jsonl"
+        with exporters.JsonlExporter(jsonl_path) as exp:
+            for row in results:
+                exp.write(exporters.make_record(
+                    "gar_bench",
+                    gar=row["gar"], n=row["n"], f=row["f"], d=row["d"],
+                    latency_s=row["latency_s"],
+                    grid=row["grid"], impl=row["impl"],
+                    wave_buckets=row["wave_buckets"],
+                    per_bucket_s=row["per_bucket_s"],
+                    below_noise_floor=row.get("below_noise_floor", False),
+                    trials=row["trials"], dce_guard=row["dce_guard"],
+                    peak_rss_bytes=row["peak_rss_bytes"],
+                ))
+    return results
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="GAR latency microbenchmark")
     p.add_argument("--gars", nargs="*", default=None)
@@ -199,6 +353,19 @@ def main(argv=None):
                         "override with --gars/--ns/--ds), peak-RSS per "
                         "row, 'hier_bench' JSONL records — HIERBENCH_r*'s "
                         "capture mode.")
+    p.add_argument("--selection", action="store_true",
+                   help="Selection micro mode: the Gram-rule selection "
+                        "step alone (Gram + scores + ranked pick), "
+                        "batched over a wave of buckets as the "
+                        "hierarchy's vmapped fold runs it, once per "
+                        "impl (sortnet vs xla_sort as explicit "
+                        "use_sortnet closures). 'gar_bench' rows with "
+                        "grid='selection' and an 'impl' field.")
+    p.add_argument("--sel_buckets", nargs="*", type=int, default=None,
+                   metavar="S",
+                   help="With --selection: bucket sizes to sweep "
+                        "(default 8 16 32; the sortnet impl requires "
+                        "S <= MAX_SORT_N).")
     p.add_argument("--hier_bucket", type=int, default=None,
                    help="Hierarchy bucket size (default MAX_SORT_N=32, "
                         "the Pallas sorting-network sweet spot).")
@@ -219,6 +386,9 @@ def main(argv=None):
                         "'hier_bench' record per cell, validated by the "
                         "tier-1 schema check).")
     args = p.parse_args(argv)
+
+    if args.selection:
+        return _selection_main(args)
 
     if args.hier:
         names = args.gars or ["hier-krum", "hier-median"]

@@ -78,6 +78,8 @@ class ShardServer:
         self._red = None
         self._round = None
         self.wire_bytes_in = 0
+        self._fused = wire.wire_fused()
+        self._scratch = None
 
     # -- round lifecycle ----------------------------------------------------
 
@@ -114,9 +116,27 @@ class ShardServer:
         pinned exactly, but it IS bounded by the whole cohort
         (n·d_shard), and ``max_elems`` rejects a header claiming more
         BEFORE a sparse frame's scatter allocates (the sparse dense-size
-        claim is otherwise sender-controlled, see wire.decode)."""
-        vec = wire.decode(buf, expect_plane=self.shard,
-                          max_elems=self._red.n * self.d_shard)
+        claim is otherwise sender-controlled, see wire.decode).
+
+        Fused path (GARFIELD_WIRE_FUSED_DECODE, default on): the frame
+        decodes into a REUSABLE per-shard scratch (wire.decode_into) —
+        one allocation per high-water frame size instead of one O(k·d)
+        transient per frame. The scratch is sized from the header's
+        claimed count CLAMPED to the cohort bound (wire.frame_elems is a
+        sizing hint, never an allocation grant), so an over-claiming
+        frame still rejects on ``max_elems`` before any allocation
+        grows past the bound."""
+        bound = self._red.n * self.d_shard
+        if self._fused:
+            claim = min(wire.frame_elems(buf), bound)
+            if self._scratch is None or self._scratch.size < claim:
+                self._scratch = np.empty(claim, np.float32)
+            k = wire.decode_into(buf, self._scratch,
+                                 expect_plane=self.shard, max_elems=bound)
+            vec = self._scratch[:k]
+        else:
+            vec = wire.decode(buf, expect_plane=self.shard,
+                              max_elems=bound)
         if vec.size % self.d_shard:
             raise wire.WireError(
                 f"shard {self.shard} frame has {vec.size} elements — "

@@ -19,7 +19,12 @@ from .coordinate import (
     MAX_SORT_N,
     averaged_median_mean,
     coordinate_median,
+    sortnet_argmin,
+    sortnet_argsort,
     sortnet_median,
+    sortnet_row_sums,
+    sortnet_sort,
+    sortnet_top_m,
     sortnet_trimmed_mean,
     trimmed_mean,
     use_pallas,
@@ -29,7 +34,12 @@ __all__ = [
     "MAX_SORT_N",
     "averaged_median_mean",
     "coordinate_median",
+    "sortnet_argmin",
+    "sortnet_argsort",
     "sortnet_median",
+    "sortnet_row_sums",
+    "sortnet_sort",
+    "sortnet_top_m",
     "sortnet_trimmed_mean",
     "trimmed_mean",
     "use_pallas",

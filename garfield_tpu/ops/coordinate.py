@@ -377,6 +377,23 @@ def trimmed_mean(g, f, *, row_map=None, row_scale=None, interpret=False,
     )
 
 
+def _sortnet_split(g, axis):
+    """``g`` split into per-index slices along ``axis`` (upcast-for-compare),
+    bounds-checked against MAX_SORT_N — the shared front half of every jnp
+    sorting-network entry point."""
+    n = g.shape[axis]
+    if n > MAX_SORT_N:
+        raise ValueError(
+            f"sorting-network path is bounded by MAX_SORT_N={MAX_SORT_N}, "
+            f"got n={n}; use the XLA sort or bucket hierarchically"
+        )
+    rows = [jax.lax.index_in_dim(g, i, axis, keepdims=False)
+            for i in range(n)]
+    if g.dtype in (jnp.bfloat16, jnp.float16):
+        rows = [r.astype(jnp.float32) for r in rows]
+    return rows
+
+
 def _sortnet_rows(g, axis):
     """Rows of ``g`` along ``axis``, sorted by the odd-even network.
 
@@ -388,17 +405,66 @@ def _sortnet_rows(g, axis):
     and the caller rounds back. O(n^2) compare-exchanges: only sane for
     n <= MAX_SORT_N, which is the bucket-size contract.
     """
+    return _oddeven_exchange(_sortnet_split(g, axis))
+
+
+def _oddeven_exchange_vec(keys, payload):
+    """Index-carrying odd-even transposition along axis 0, one vectorized
+    compare-exchange per round.
+
+    The SAME network schedule as ``_oddeven_exchange`` (n rounds of
+    adjacent compare-exchange under the strict-< NaN-last comparator, so
+    ties keep ascending payload order — ``jnp.argsort(..., stable=True)``
+    parity), but each round's pairs swap as two strided slices instead of
+    n scalar chains. The list form with payloads compiles PATHOLOGICALLY
+    on XLA:CPU (~50 s at n=30: the 2n² interleaved key/payload SSA chains
+    defeat the fusion pass; measured, see DESIGN.md §21) while this form
+    is O(n) HLO ops and compiles in ~1 s with identical semantics.
+    """
+    n = keys.shape[0]
+    for rnd in range(n):
+        off = rnd % 2
+        npairs = (n - off) // 2
+        if npairs == 0:
+            continue
+        end = off + 2 * npairs
+        lo, hi = keys[off:end:2], keys[off + 1:end:2]
+        m = _swap_mask(lo, hi)
+        merged = jnp.stack(
+            [jnp.where(m, hi, lo), jnp.where(m, lo, hi)], axis=1
+        ).reshape((2 * npairs,) + keys.shape[1:])
+        keys = jnp.concatenate([keys[:off], merged, keys[end:]], axis=0)
+        plo, phi = payload[off:end:2], payload[off + 1:end:2]
+        pm = jnp.stack(
+            [jnp.where(m, phi, plo), jnp.where(m, plo, phi)], axis=1
+        ).reshape((2 * npairs,) + payload.shape[1:])
+        payload = jnp.concatenate([payload[:off], pm, payload[end:]], axis=0)
+    return keys, payload
+
+
+def _sortnet_index(g, axis):
+    """(sorted keys, permuted index payload) along ``axis`` (moved to axis
+    0): the index-carrying network behind argmin/top_m/argsort. Bounds and
+    upcast exactly like ``_sortnet_split``; the emitted permutation is the
+    stable NaN-last order of ``jnp.argsort(..., stable=True)`` — strict
+    ``<`` never swaps equal keys, so ties keep ascending index order.
+    This is what makes sortnet selection substitutable for the stable-
+    argsort selection on the krum/multi-krum/bulyan Gram paths.
+    """
     n = g.shape[axis]
     if n > MAX_SORT_N:
         raise ValueError(
             f"sorting-network path is bounded by MAX_SORT_N={MAX_SORT_N}, "
             f"got n={n}; use the XLA sort or bucket hierarchically"
         )
-    rows = [jax.lax.index_in_dim(g, i, axis, keepdims=False)
-            for i in range(n)]
+    keys = jnp.moveaxis(g, axis, 0)
     if g.dtype in (jnp.bfloat16, jnp.float16):
-        rows = [r.astype(jnp.float32) for r in rows]
-    return _oddeven_exchange(rows)
+        keys = keys.astype(jnp.float32)
+    shape = (n,) + (1,) * (keys.ndim - 1)
+    idx = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32).reshape(shape), keys.shape
+    )
+    return _oddeven_exchange_vec(keys, idx)
 
 
 def sortnet_median(g, *, axis=-2):
@@ -429,6 +495,73 @@ def sortnet_trimmed_mean(g, f, *, axis=-2):
     for i in range(f + 1, n - f):
         acc = acc + rows[i]
     return (acc / (n - 2 * f)).astype(g.dtype)
+
+
+def sortnet_sort(keys, *, axis=-1):
+    """``jnp.sort(keys, axis=axis)`` via the odd-even network: same total
+    order (ascending, NaN last), bitwise-identical output — values are
+    permuted by ``where`` swaps, never recomputed. Bounded by MAX_SORT_N
+    along ``axis`` (loud ValueError above it); vmap/batch-safe on every
+    backend. Half inputs compare (and return) in f32."""
+    keys = jnp.asarray(keys)
+    return jnp.stack(_sortnet_rows(keys, axis), axis=axis)
+
+
+def sortnet_argsort(keys, *, axis=-1):
+    """``jnp.argsort(keys, axis=axis, stable=True)`` via the index-carrying
+    network (int32 indices): stable ties, NaN-last. The full permutation —
+    Bulyan's phase-1 scatter needs all n positions; prefer
+    ``sortnet_argmin``/``sortnet_top_m`` when only a prefix is consumed."""
+    keys = jnp.asarray(keys)
+    _, idx = _sortnet_index(keys, axis)
+    return jnp.moveaxis(idx, 0, axis % keys.ndim)
+
+
+def sortnet_argmin(keys, *, axis=-1):
+    """Index of the minimum along ``axis`` (first index on ties, NaN last)
+    — ``jnp.argsort(keys, stable=True)[..., 0]`` without materializing the
+    permutation. Shape: ``keys`` with ``axis`` removed; int32."""
+    keys = jnp.asarray(keys)
+    _, idx = _sortnet_index(keys, axis)
+    return idx[0]
+
+
+def sortnet_top_m(keys, m, *, axis=-1):
+    """Indices of the m smallest along ``axis``, best first — the stable
+    NaN-last prefix ``jnp.argsort(keys, stable=True)[..., :m]``. This is
+    (multi-)krum's selection: m best-scored rows, ties to the lowest
+    index."""
+    keys = jnp.asarray(keys)
+    n = keys.shape[axis]
+    if not (1 <= m <= n):
+        raise ValueError(f"m must be in [1, {n}], got {m}")
+    _, idx = _sortnet_index(keys, axis)
+    return jnp.moveaxis(idx[:m], 0, axis % keys.ndim)
+
+
+def sortnet_row_sums(dist, k, *, axis=-1):
+    """Sum of the k smallest entries along ``axis`` in EXPLICIT ascending
+    order — krum's score without materializing the full sorted matrix.
+
+    The accumulation is a sequential add chain over the network's sorted
+    rows (smallest first), the same idiom as ``sortnet_trimmed_mean`` /
+    the Pallas ``_tmean_kernel``. A chain is the bitwise-robust form: XLA
+    never reassociates explicit float adds, whereas ``jnp.sum`` over an
+    axis is free to regroup its reduce per fusion context — measured on
+    XLA:CPU to flip last bits between programs computing the SAME
+    ``jnp.sum(jnp.sort(d)[..., :k])`` expression (DESIGN.md §21). Krum's
+    slow path chains the sorted slices identically, so toggling
+    GARFIELD_SORTNET_SELECT cannot move a trajectory. Half inputs sum
+    (and return) in f32, like every sortnet entry point."""
+    dist = jnp.asarray(dist)
+    n = dist.shape[axis]
+    if not (1 <= k <= n):
+        raise ValueError(f"k must be in [1, {n}], got {k}")
+    rows = _sortnet_rows(dist, axis)
+    acc = rows[0]
+    for i in range(1, k):
+        acc = acc + rows[i]
+    return acc
 
 
 def averaged_median_mean(g, beta, *, interpret=False, tile=_TILE):

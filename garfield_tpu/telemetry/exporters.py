@@ -126,7 +126,15 @@ SCHEMA = "garfield-telemetry"
 # carry the EXCHBENCH_r05 robustness-cell fields (``cell``,
 # ``final_accuracy``, ``attack_magnitude``, ``headroom``,
 # ``compression_ratio``, ``matched_accuracy``).
-SCHEMA_VERSION = 11
+# v12 (round 19, kernel-grade robust selection — DESIGN.md §21):
+# ``fed_bench`` rows may carry a ``phases`` sub-object (the
+# exchange_bench v5 shape: phase name -> numeric stat object, here the
+# per-phase ingest/h2d/fold/selection p50/p95 from the trace plane — a
+# scaling row attributes WHERE its round time went, not just how much),
+# and ``gar_bench`` rows may carry the --selection micro-mode fields
+# (``grid``, ``impl`` — sortnet vs xla_sort as explicit closures —
+# ``wave_buckets``, ``per_bucket_s``), all validated below.
+SCHEMA_VERSION = 12
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
          "transfer_bench", "exchange_bench", "hier_bench", "span",
@@ -690,6 +698,28 @@ def validate_record(rec):
         lat = rec.get("latency_s")
         if lat is not None and not _is_num(lat):
             _fail(f"gar_bench.latency_s must be a number or null, got {lat!r}")
+        # v12: the --selection micro-mode columns (all optional — plain
+        # sweep rows predate them).
+        for key in ("grid", "impl"):
+            val = rec.get(key)
+            if val is not None and not isinstance(val, str):
+                _fail(
+                    f"gar_bench.{key} must be a string or null, got {val!r}"
+                )
+        wb = rec.get("wave_buckets")
+        if wb is not None and (
+            not isinstance(wb, int) or isinstance(wb, bool) or wb < 1
+        ):
+            _fail(
+                f"gar_bench.wave_buckets must be a positive int or null, "
+                f"got {wb!r}"
+            )
+        pb = rec.get("per_bucket_s")
+        if pb is not None and not _is_num(pb):
+            _fail(
+                f"gar_bench.per_bucket_s must be a number or null, got "
+                f"{pb!r}"
+            )
     elif kind == "hier_bench":
         if not isinstance(rec.get("gar"), str):
             _fail(f"hier_bench.gar must be a string, got {rec.get('gar')!r}")
@@ -815,6 +845,19 @@ def validate_record(rec):
             val = rec.get(key)
             if val is not None:
                 _check_float_list("fed_bench", key, val)
+        phases = rec.get("phases")
+        if phases is not None:
+            # v12: per-phase p50/p95 attribution on scaling rows
+            # (ingest/h2d/fold/selection from the trace plane) — the
+            # exchange_bench v5 shape, so readers share one parser.
+            if not isinstance(phases, dict) or not all(
+                isinstance(v, dict) and all(_is_num(x) for x in v.values())
+                for v in phases.values()
+            ):
+                _fail(
+                    f"fed_bench.phases must map phases to numeric "
+                    f"stat objects, got {phases!r}"
+                )
         for key in ("s1_bitwise_equal", "budget_exceeded"):
             val = rec.get(key)
             if val is not None and not isinstance(val, bool):

@@ -86,12 +86,15 @@ __all__ = [
     "ErrorFeedback",
     "wire_dtype",
     "wire_topk",
+    "wire_fused",
     "topk_k",
     "check_plane",
     "encode",
     "decode",
+    "decode_into",
     "frame_plane",
     "frame_scheme",
+    "frame_elems",
     "frame_nbytes",
     "HEADER_NBYTES",
     "MAX_PLANE",
@@ -168,6 +171,19 @@ def wire_topk():
             f"GARFIELD_WIRE_TOPK must be >= 0 (0 = off), got {div}"
         )
     return div
+
+
+def wire_fused():
+    """Whether frame consumers take the fused decode-into-buffer path
+    (``GARFIELD_WIRE_FUSED_DECODE``, default on): ``decode_into``
+    straight into the streaming wave buffer / a reusable shard scratch
+    instead of materializing a fresh O(elems) array per frame. Purely a
+    memory-traffic knob — both paths are bitwise-identical and run the
+    same validation (pinned in tests/test_wire.py), so turning it off is
+    only for isolating the fused path when debugging."""
+    return os.environ.get(
+        "GARFIELD_WIRE_FUSED_DECODE", "1"
+    ).lower() not in ("", "0", "false")
 
 
 def topk_k(elems, div):
@@ -390,6 +406,30 @@ def decode(buf, *, expect_plane=None, expect_elems=None, max_elems=None):
     sparse frame decoded with neither is an unbounded allocation the
     sender controls.
     """
+    tag, elems, payload = _checked_frame(
+        buf, expect_plane, expect_elems, max_elems
+    )
+    if tag == _TAG_BF16:
+        return _bf16_to_f32(np.frombuffer(payload, np.uint16))
+    if tag == _TAG_F32:
+        return np.frombuffer(payload, np.float32)
+    if tag in (_TAG_INT8, _TAG_INT4):
+        codes, scales, block = _checked_quant(payload, tag, elems)
+        return _dequant(codes, scales, block, elems)
+    pairs = _checked_pairs(payload, elems)
+    out = np.zeros(elems, np.float32)
+    out[pairs["i"].astype(np.int64)] = pairs["v"]
+    return out
+
+
+def _checked_frame(buf, expect_plane, expect_elems, max_elems):
+    """Shared header + structural + CRC validation of ``decode`` and
+    ``decode_into``: returns ``(low-nibble tag, elems, payload)`` only
+    for a frame whose bytes are provably the sender's and whose payload
+    length is consistent with the header. Semantic payload validation
+    (scale range, code grid, index ordering) is per-tag
+    (``_checked_quant`` / ``_checked_pairs``) and also precedes any
+    output construction."""
     if len(buf) < HEADER_NBYTES:
         raise WireError(
             f"truncated frame: {len(buf)} bytes is shorter than the "
@@ -454,84 +494,161 @@ def decode(buf, *, expect_plane=None, expect_elems=None, max_elems=None):
             )
     if zlib.crc32(payload) != crc:
         raise WireError("payload CRC mismatch")
-    if tag == _TAG_BF16:
-        return _bf16_to_f32(np.frombuffer(payload, np.uint16))
-    if tag == _TAG_F32:
-        return np.frombuffer(payload, np.float32)
-    if tag in (_TAG_INT8, _TAG_INT4):
-        block = int(np.frombuffer(payload, "<u4", count=1)[0])
-        if block < 1:
-            raise WireError(f"quantization block {block} must be >= 1")
-        if block > max(int(elems), 1):
-            # An honest encoder clamps its block to the vector (same
-            # values, see encode); a larger block is an allocation bomb —
-            # the dequant pad is nblocks*block elements, which a
-            # block=0xFFFFFFFF prefix on a tiny frame turns into ~17 GB.
-            # This bound keeps it under 2x elems.
-            raise WireError(
-                f"quantization block {block} exceeds the frame's "
-                f"{elems} elements"
-            )
-        nblocks = -(-int(elems) // block) if elems else 0
-        codes_nbytes = (
-            int(elems) if tag == _TAG_INT8 else (int(elems) + 1) // 2
+    return tag, int(elems), payload
+
+
+def _checked_quant(payload, tag, elems):
+    """Semantic validation of a quantized payload (block bound, scale
+    range, honest-grid codes) — every check the dequant step relies on,
+    BEFORE any dequant output is written, so ``decode_into`` leaves its
+    target untouched on ban evidence. Returns ``(codes, scales, block)``."""
+    block = int(np.frombuffer(payload, "<u4", count=1)[0])
+    if block < 1:
+        raise WireError(f"quantization block {block} must be >= 1")
+    if block > max(int(elems), 1):
+        # An honest encoder clamps its block to the vector (same
+        # values, see encode); a larger block is an allocation bomb —
+        # the dequant pad is nblocks*block elements, which a
+        # block=0xFFFFFFFF prefix on a tiny frame turns into ~17 GB.
+        # This bound keeps it under 2x elems.
+        raise WireError(
+            f"quantization block {block} exceeds the frame's "
+            f"{elems} elements"
         )
-        if len(payload) != 4 + nblocks * 4 + codes_nbytes:
+    nblocks = -(-int(elems) // block) if elems else 0
+    codes_nbytes = (
+        int(elems) if tag == _TAG_INT8 else (int(elems) + 1) // 2
+    )
+    if len(payload) != 4 + nblocks * 4 + codes_nbytes:
+        raise WireError(
+            f"quantized payload is {len(payload)} bytes but "
+            f"{elems} elements at block {block} need "
+            f"{4 + nblocks * 4 + codes_nbytes}"
+        )
+    scales = np.frombuffer(payload, "<f4", count=nblocks, offset=4)
+    # Range check (the ISSUE's scale gate): a NaN/inf or negative
+    # scale lets a Byzantine sender smuggle unbounded or
+    # sign-flipped rows through an otherwise-valid frame.
+    if nblocks and not (np.isfinite(scales).all()
+                        and (scales >= 0).all()):
+        raise WireError(
+            "quantization scale out of range (non-finite or negative)"
+        )
+    raw = np.frombuffer(payload, np.uint8, offset=4 + nblocks * 4)
+    if tag == _TAG_INT8:
+        codes = raw.view(np.int8)
+        if codes.size and (codes == -128).any():
+            # The symmetric grid is [-127, 127] (encode clips at
+            # qmax): code -128 is unreachable by any honest encoder
+            # — ban evidence exactly like int4's nibble 0.
             raise WireError(
-                f"quantized payload is {len(payload)} bytes but "
-                f"{elems} elements at block {block} need "
-                f"{4 + nblocks * 4 + codes_nbytes}"
+                "int8 code -128 is outside the symmetric grid"
             )
-        scales = np.frombuffer(payload, "<f4", count=nblocks, offset=4)
-        # Range check (the ISSUE's scale gate): a NaN/inf or negative
-        # scale lets a Byzantine sender smuggle unbounded or
-        # sign-flipped rows through an otherwise-valid frame.
-        if nblocks and not (np.isfinite(scales).all()
-                            and (scales >= 0).all()):
-            raise WireError(
-                "quantization scale out of range (non-finite or negative)"
-            )
-        raw = np.frombuffer(payload, np.uint8, offset=4 + nblocks * 4)
-        if tag == _TAG_INT8:
-            codes = raw.view(np.int8)
-            if codes.size and (codes == -128).any():
-                # The symmetric grid is [-127, 127] (encode clips at
-                # qmax): code -128 is unreachable by any honest encoder
-                # — ban evidence exactly like int4's nibble 0.
-                raise WireError(
-                    "int8 code -128 is outside the symmetric grid"
-                )
-        else:
-            nib = np.empty(raw.size * 2, np.uint8)
-            nib[0::2] = raw & 0x0F
-            nib[1::2] = raw >> 4
-            nib = nib[: int(elems)]
-            if nib.size and (nib == 0).any():
-                # The biased-nibble grid is [1, 15] (code -7..7 + 8);
-                # nibble 0 is unreachable by any honest encoder.
-                raise WireError("int4 nibble 0 is outside the biased grid")
-            codes = nib.astype(np.int16) - 8
-        return _dequant(codes, scales, block, int(elems))
-    # _TAG_TOPK: scatter the sorted (index, value) pairs into a dense
-    # f32 vector. Index validation is the sparse scheme's ban teeth —
-    # without it a Byzantine sender could double-count a coordinate
-    # (duplicate index) or write out of bounds.
+    else:
+        nib = np.empty(raw.size * 2, np.uint8)
+        nib[0::2] = raw & 0x0F
+        nib[1::2] = raw >> 4
+        nib = nib[: int(elems)]
+        if nib.size and (nib == 0).any():
+            # The biased-nibble grid is [1, 15] (code -7..7 + 8);
+            # nibble 0 is unreachable by any honest encoder.
+            raise WireError("int4 nibble 0 is outside the biased grid")
+        codes = nib.astype(np.int16) - 8
+    return codes, scales, block
+
+
+def _checked_pairs(payload, elems):
+    """Semantic validation of a sparse payload: the (index, value) pairs
+    ready to scatter. Index validation is the sparse scheme's ban teeth —
+    without it a Byzantine sender could double-count a coordinate
+    (duplicate index) or write out of bounds."""
     pairs = np.frombuffer(payload, _PAIR)
-    idx = pairs["i"].astype(np.int64)
+    idx = pairs["i"]
     if idx.size:
-        if idx[-1] >= elems:
+        if int(idx[-1]) >= elems:
             raise WireError(
                 f"sparse index {int(idx[-1])} out of bounds for "
                 f"{elems} elements"
             )
-        if idx.size > 1 and not (np.diff(idx) > 0).all():
+        if idx.size > 1 and not (np.diff(idx.astype(np.int64)) > 0).all():
             raise WireError(
                 "sparse indices must be strictly increasing "
                 "(duplicate or descending index)"
             )
-    out = np.zeros(int(elems), np.float32)
-    out[idx] = pairs["v"]
-    return out
+    return pairs
+
+
+def decode_into(buf, out, *, expect_plane=None, expect_elems=None,
+                max_elems=None):
+    """Decode a typed frame DIRECTLY into a preallocated float32 row;
+    returns the element count written (``out[:elems]``).
+
+    The fused half of the streaming ingest path (DESIGN.md §21):
+    ``decode`` materializes an O(elems) float32 result that the reducer
+    then memcpys into its wave buffer — at federated scale that
+    transient is touched exactly once. ``decode_into`` runs the SAME
+    validation pipeline (same ``WireError`` texts, same ban evidence)
+    and then dequantizes/scatters straight into the caller's buffer
+    row, bitwise-identical values to ``decode``:
+
+    - f32/bf16 copy (bf16 via the exact ``u16 << 16`` widening, written
+      through a uint32 view of the target);
+    - int8/int4 dequantize per block with ``np.multiply(..., out=...)``
+      — full blocks as one (nblocks, block) broadcast into the target,
+      the ragged tail block against its scalar scale; both are the same
+      f32 multiply ``_dequant`` does, minus the pad + slice copies;
+    - topk zero-fills then scatters, only after index validation.
+
+    Validation ALWAYS completes before the first byte of ``out`` is
+    written: a frame that raises leaves the target untouched (pinned in
+    tests/test_wire.py), so a Byzantine frame cannot scribble on a wave
+    buffer slot it failed to claim. ``elems`` must fit ``out`` — with
+    neither ``expect_elems`` nor ``max_elems`` given, ``out.size`` is
+    the implicit allocation bound (the target IS the allocation, so a
+    sparse frame's dense-size claim is bounded either way).
+    """
+    out = np.asarray(out)
+    if (out.dtype != np.float32 or out.ndim != 1
+            or not out.flags.c_contiguous or not out.flags.writeable):
+        raise TypeError(
+            "decode_into target must be a writable C-contiguous 1-D "
+            f"float32 array, got {out.dtype} ndim={out.ndim}"
+        )
+    if expect_elems is None and max_elems is None:
+        max_elems = out.size
+    tag, elems, payload = _checked_frame(
+        buf, expect_plane, expect_elems, max_elems
+    )
+    if elems > out.size:
+        raise WireError(
+            f"frame carries {elems} elements but the target row holds "
+            f"only {out.size}"
+        )
+    dst = out[:elems]
+    if tag == _TAG_F32:
+        dst[...] = np.frombuffer(payload, np.float32)
+    elif tag == _TAG_BF16:
+        np.left_shift(
+            np.frombuffer(payload, np.uint16), np.uint32(16),
+            out=dst.view(np.uint32), dtype=np.uint32, casting="unsafe",
+        )
+    elif tag in (_TAG_INT8, _TAG_INT4):
+        codes, scales, block = _checked_quant(payload, tag, elems)
+        cf = codes.astype(np.float32)
+        nfull = elems // block
+        split = nfull * block
+        if nfull:
+            np.multiply(
+                cf[:split].reshape(nfull, block), scales[:nfull, None],
+                out=dst[:split].reshape(nfull, block),
+            )
+        if split < elems:
+            np.multiply(cf[split:], scales[nfull], out=dst[split:])
+    else:
+        pairs = _checked_pairs(payload, elems)
+        dst[...] = 0.0
+        dst[pairs["i"].astype(np.int64)] = pairs["v"]
+    return elems
 
 
 def frame_plane(buf):
@@ -569,6 +686,26 @@ def frame_scheme(buf):
     if tag not in _TAG_NAME:
         raise WireError(f"unknown dtype tag {tag}")
     return _TAG_NAME[tag]
+
+
+def frame_elems(buf):
+    """The CLAIMED dense element count of a typed frame's header;
+    raises WireError on a short header or bad magic. Header-only like
+    ``frame_plane`` — the claim is unvalidated (a sparse frame's count
+    is a bare sender assertion until ``decode``/``decode_into`` pins or
+    bounds it), so this is strictly a SIZING hint: consumers use it to
+    right-size a reusable scratch target, clamped to their own bound,
+    and let the full decode reject an over-claiming frame before any
+    write."""
+    if len(buf) < HEADER_NBYTES:
+        raise WireError(
+            f"truncated frame: {len(buf)} bytes is shorter than the "
+            f"{HEADER_NBYTES}-byte header"
+        )
+    magic, _, _, elems, _ = _HDR.unpack_from(buf)
+    if magic != _MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    return int(elems)
 
 
 def frame_nbytes(elems, dtype=None, *, k=None, block=QUANT_BLOCK):

@@ -27,7 +27,10 @@ the v11 compression additions (the ``wire`` event's per-scheme byte
 breakdown + compression_ratio/ef_residual_norm, ``summary.wire_schemes``,
 and EXCHBENCH_r05's ``--robust`` exchange_bench rows with their
 cell/matched_accuracy/headroom columns; auto-globbed like every
-``*_r*.jsonl``).
+``*_r*.jsonl``) — and the v12 selection-kernel additions (FEDBENCH_r02's
+``fed_bench`` scaling rows with their per-phase ``phases`` p50/p95
+attribution — ingest/h2d/fold/selection — and SELBENCH-style
+``gar_bench`` rows with grid/impl/wave_buckets/per_bucket_s columns).
 
   python scripts/validate_artifacts.py            # repo root auto-found
   python scripts/validate_artifacts.py /some/repo

@@ -563,3 +563,47 @@ class TestStreamingAdditions:
         red2.push_many(np.zeros((4, 6), np.float32))
         with pytest.raises(ValueError, match="expected"):
             red2.push_many(np.zeros((4, 5), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# telemetry schema v12: per-phase attribution + selection micro-rows
+
+
+class TestTelemetryV12:
+    def test_fed_bench_phases_validate(self):
+        exporters.validate_record(exporters.make_record(
+            "fed_bench", check="scaling", n=10 ** 6, d=10 ** 4, shards=4,
+            gar="hier-krum", round_s=1.0,
+            phases={
+                "ingest": {"count": 8, "p50_s": 0.01, "p95_s": 0.02},
+                "h2d": {"count": 8, "p50_s": 0.001, "p95_s": 0.002},
+                "fold": {"count": 8, "p50_s": 0.005, "p95_s": 0.009},
+                "selection": {"count": 24, "p50_s": 3e-4, "p95_s": 9e-4},
+            },
+        ))
+
+    @pytest.mark.parametrize("phases", [
+        "ingest",                               # not an object
+        {"ingest": [0.1, 0.2]},                 # stats not an object
+        {"ingest": {"p50_s": "fast"}},          # non-numeric stat
+    ])
+    def test_malformed_fed_bench_phases_rejected(self, phases):
+        with pytest.raises(ValueError, match="phases"):
+            exporters.validate_record(exporters.make_record(
+                "fed_bench", check="scaling", n=10, d=10, shards=1,
+                gar="hier-krum", phases=phases,
+            ))
+
+    def test_gar_bench_selection_rows_validate(self):
+        exporters.validate_record(exporters.make_record(
+            "gar_bench", gar="krum", n=16, f=6, d=256, latency_s=6.7e-5,
+            grid="selection", impl="sortnet", wave_buckets=8,
+            per_bucket_s=8.3e-6, trials=3, dce_guard="softsign",
+        ))
+        for bad in [{"impl": 7}, {"wave_buckets": 0},
+                    {"per_bucket_s": "x"}, {"grid": 1}]:
+            with pytest.raises(ValueError):
+                exporters.validate_record(exporters.make_record(
+                    "gar_bench", gar="krum", n=16, f=6, d=256,
+                    latency_s=1e-5, **bad,
+                ))

@@ -507,3 +507,69 @@ def test_coordinatewise_tree_matches_flat(name, kwargs):
     flat_out = np.asarray(gars[name](flat, **kwargs))
     np.testing.assert_allclose(flat_from_tree, flat_out, rtol=1e-6,
                                atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Sortnet-selection substitutability (PR 19): GARFIELD_SORTNET_SELECT
+# defaults on, so the sortnet Gram paths must be BITWISE equal to the
+# stable-argsort paths — not merely close. Tie-heavy stacks (duplicated
+# rows give exactly equal pairwise distances, hence equal scores) are
+# the cases where an unstable or differently-ordered pick would diverge.
+
+class TestSortnetSelectSubstitutable:
+    def _tie_stack(self, n, d, seed):
+        g = np.random.default_rng(seed).normal(size=(n, d))
+        g = g.astype(np.float32)
+        g[n // 2] = g[0]  # duplicate row: tied distances + tied scores
+        return g
+
+    @pytest.mark.parametrize("n,f,m", [
+        (7, 2, None), (11, 3, 4), (15, 4, 1),
+        (40, 12, None),  # n > MAX_SORT_N: the top_k/argsort fallbacks
+    ])
+    def test_krum_bitwise_on_off(self, n, f, m):
+        from garfield_tpu.aggregators import krum
+
+        g = self._tie_stack(n, 24, seed=n)
+        np.testing.assert_array_equal(
+            np.asarray(krum.aggregate(g, f, m=m, use_sortnet=True)),
+            np.asarray(krum.aggregate(g, f, m=m, use_sortnet=False)),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(krum.selection_indices(g, f, m=m,
+                                              use_sortnet=True)),
+            np.asarray(krum.selection_indices(g, f, m=m,
+                                              use_sortnet=False)),
+        )
+
+    @pytest.mark.parametrize("n,f", [(7, 1), (12, 2), (35, 5)])
+    def test_bulyan_bitwise_on_off(self, n, f):
+        from garfield_tpu.aggregators import bulyan
+
+        g = self._tie_stack(n, 16, seed=100 + n)
+        np.testing.assert_array_equal(
+            np.asarray(bulyan.aggregate(g, f, use_sortnet=True)),
+            np.asarray(bulyan.aggregate(g, f, use_sortnet=False)),
+        )
+
+    def test_gram_select_bitwise_on_off(self):
+        from garfield_tpu.aggregators import krum
+
+        g = self._tie_stack(9, 12, seed=77)
+        gram = jnp.matmul(jnp.asarray(g), jnp.asarray(g).T,
+                          preferred_element_type=jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(krum.gram_select(gram, 2, use_sortnet=True)),
+            np.asarray(krum.gram_select(gram, 2, use_sortnet=False)),
+        )
+
+    def test_env_knob_parses(self, monkeypatch):
+        from garfield_tpu.aggregators import krum
+
+        for raw, want in [("1", True), ("0", False), ("false", False),
+                          ("", False), ("on", True)]:
+            monkeypatch.setenv("GARFIELD_SORTNET_SELECT", raw)
+            assert krum._sortnet_select(None) is want
+        monkeypatch.delenv("GARFIELD_SORTNET_SELECT")
+        assert krum._sortnet_select(None) is True  # default on
+        assert krum._sortnet_select(False) is False  # explicit wins

@@ -353,3 +353,133 @@ def test_audit_matches_batch_and_stream():
     red.push_many(poisoned)
     red.finalize()
     assert np.array_equal(red.audit()["selected"], audit["selected"])
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered wave fold (PR 19): overlap must be bitwise-invisible.
+
+
+class TestDoubleBuffer:
+    @pytest.mark.parametrize("double", [False, True])
+    @pytest.mark.parametrize("mode", ["one", "many", "mixed"])
+    def test_streaming_equals_batch_all_ingest_modes(self, double, mode):
+        n, d, f = 200, 64, 9
+        g = honest_stack(n, d)
+        batch = np.asarray(hierarchy.aggregate(
+            g, f, bucket_gar="krum", bucket_size=16))
+        red = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=16, wave_buckets=3,
+            double_buffer=double)
+        if mode == "one":
+            for row in g:
+                red.push(row)
+        elif mode == "many":
+            red.push_many(g)
+        else:
+            red.push_many(g[:131])
+            for row in g[131:140]:
+                red.push(row)
+            red.push_many(g[140:])
+        assert np.array_equal(red.finalize(), batch)
+
+    def test_push_many_across_buffer_swap(self):
+        # Regression: push_many once cached the active buffer across its
+        # fill loop, but a mid-loop drain SWAPS buffers in double-buffer
+        # mode — later rows landed in the buffer the in-flight wave
+        # still aliased while the real target stayed uninitialized
+        # (visible as a wholly wrong aggregate at n >= 1024).
+        n, d, f = 1024, 32, 20
+        g = honest_stack(n, d)
+        want = np.asarray(hierarchy.aggregate(
+            g, f, bucket_gar="median", bucket_size=32))
+        red = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="median", bucket_size=32, wave_buckets=4,
+            double_buffer=True)
+        red.push_many(g)  # one call: must survive every internal swap
+        assert np.array_equal(red.finalize(), want)
+
+    def test_reset_round_trip_under_double_buffer(self):
+        n, d, f = 256, 48, 6
+        red = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=16, wave_buckets=3,
+            double_buffer=True)
+        outs = []
+        for r in range(2):
+            g = honest_stack(n, d)
+            red.push_many(g)
+            outs.append((g, red.finalize().copy()))
+            red.reset()
+        for g, got in outs:
+            want = np.asarray(hierarchy.aggregate(
+                g, f, bucket_gar="krum", bucket_size=16))
+            assert np.array_equal(got, want)
+
+    def test_audit_identical_on_off(self):
+        n, d, f = 200, 40, 5
+        g = honest_stack(n, d)
+        g[7] *= -80.0  # a reversed client the audit should flag
+        keeps = []
+        for double in (False, True):
+            red = hierarchy.StreamingAggregator(
+                n, f, bucket_gar="krum", bucket_size=16, wave_buckets=3,
+                audit=True, double_buffer=double)
+            red.push_many(g)
+            red.finalize()
+            keeps.append(red.audit()["selected"].copy())
+        assert np.array_equal(keeps[0], keeps[1])
+
+    def test_env_knob_default_on(self, monkeypatch):
+        monkeypatch.delenv("GARFIELD_HIER_DOUBLE_BUFFER", raising=False)
+        assert hierarchy.StreamingAggregator(64, 2)._double is True
+        monkeypatch.setenv("GARFIELD_HIER_DOUBLE_BUFFER", "0")
+        assert hierarchy.StreamingAggregator(64, 2)._double is False
+        # explicit argument beats the environment
+        assert hierarchy.StreamingAggregator(
+            64, 2, double_buffer=True)._double is True
+
+
+class TestFusedFrameIngest:
+    @pytest.mark.parametrize("scheme", ["f32", "bf16", "int8", "int4",
+                                        "topk"])
+    def test_fused_equals_unfused_equals_batch(self, scheme, monkeypatch):
+        n, d, f = 96, 64, 4
+        g = honest_stack(n, d)
+        frames = [wire.encode(row, dtype=scheme) for row in g]
+        rows = np.stack([wire.decode(fr, expect_elems=d) for fr in frames])
+        want = None
+        outs = {}
+        for fused in ("1", "0"):
+            monkeypatch.setenv("GARFIELD_WIRE_FUSED_DECODE", fused)
+            red = hierarchy.StreamingAggregator(
+                n, f, bucket_gar="krum", bucket_size=16, wave_buckets=3,
+                d=d)
+            assert red._fused is (fused == "1")
+            for fr in frames:
+                red.push_frame(fr)
+            outs[fused] = red.finalize()
+        want = np.asarray(hierarchy.aggregate(
+            rows, f, bucket_gar="krum", bucket_size=16))
+        assert np.array_equal(outs["1"], outs["0"])
+        assert np.array_equal(outs["1"], want)
+
+    def test_fused_reject_leaves_trajectory_intact(self, monkeypatch):
+        monkeypatch.setenv("GARFIELD_WIRE_FUSED_DECODE", "1")
+        n, d, f = 48, 32, 2
+        g = honest_stack(n, d)
+        frames = [wire.encode(row) for row in g]
+        red = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=16, wave_buckets=2, d=d)
+        bad = bytearray(frames[5])
+        bad[-1] ^= 0xFF  # CRC break mid-stream
+        for i, fr in enumerate(frames):
+            if i == 5:
+                with pytest.raises(wire.WireError):
+                    red.push_frame(bytes(bad))
+                # the reject must not consume an ingest slot
+                assert red._arrived == 5
+            red.push_frame(fr)
+        ref = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=16, wave_buckets=2, d=d)
+        for fr in frames:
+            ref.push_frame(fr)
+        assert np.array_equal(red.finalize(), ref.finalize())

@@ -334,3 +334,95 @@ def test_remap_validates_bounds():
         coordinate.coordinate_median(
             x, row_map=[0, 1], row_scale=[1.0, 1.0, 1.0]
         )
+
+
+class TestSortNetSelection:
+    """The index-carrying network entry points (PR 19's selection
+    kernels): bitwise-equal to ``jnp.argsort(..., stable=True)`` —
+    stable ties, NaN-last — under vmap and bf16 upcast, plus the krum
+    score's chained prefix sum and the MAX_SORT_N bound. These are the
+    substitutability pins that let GARFIELD_SORTNET_SELECT default on
+    without moving any Gram-path trajectory."""
+
+    def _keys(self, w, n, seed, ties=False, nans=0):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((w, n)).astype(np.float32)
+        if ties:
+            # Quantize hard so duplicate keys are guaranteed: stability
+            # is only observable on ties.
+            x = np.round(x * 2.0) / 2.0
+        if nans:
+            for r in range(w):
+                x[r, rng.choice(n, size=nans, replace=False)] = np.nan
+        return x
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 8, 17, 32])
+    @pytest.mark.parametrize("ties,nans", [(False, 0), (True, 0),
+                                           (False, 2), (True, 2)])
+    def test_argsort_matches_stable_argsort(self, n, ties, nans):
+        if nans >= n:
+            pytest.skip("need at least one finite key")
+        x = self._keys(6, n, seed=n * 7 + nans, ties=ties, nans=nans)
+        got = np.asarray(coordinate.sortnet_argsort(x, axis=-1))
+        want = np.asarray(jnp.argsort(x, axis=-1, stable=True))
+        np.testing.assert_array_equal(got, want)
+
+    def test_argmin_and_top_m_are_argsort_prefixes(self):
+        x = self._keys(5, 16, seed=3, ties=True, nans=1)
+        ref = np.asarray(jnp.argsort(x, axis=-1, stable=True))
+        np.testing.assert_array_equal(
+            np.asarray(coordinate.sortnet_argmin(x, axis=-1)), ref[:, 0])
+        np.testing.assert_array_equal(
+            np.asarray(coordinate.sortnet_top_m(x, 5, axis=-1)),
+            ref[:, :5])
+
+    def test_sort_matches_jnp_sort_bitwise(self):
+        x = self._keys(4, 23, seed=9, ties=True, nans=3)
+        np.testing.assert_array_equal(
+            np.asarray(coordinate.sortnet_sort(x, axis=-1)),
+            np.asarray(jnp.sort(x, axis=-1)))
+
+    def test_vmap_matches_loop(self):
+        xb = self._keys(7, 12, seed=5, ties=True)
+        got = np.asarray(jax.vmap(
+            lambda r: coordinate.sortnet_top_m(r, 4, axis=-1))(xb))
+        want = np.stack([
+            np.asarray(coordinate.sortnet_top_m(xb[i], 4, axis=-1))
+            for i in range(7)
+        ])
+        np.testing.assert_array_equal(got, want)
+
+    def test_bf16_upcast_orders_like_f32(self):
+        x = jnp.asarray(self._keys(4, 20, seed=11, ties=True),
+                        jnp.bfloat16)
+        np.testing.assert_array_equal(
+            np.asarray(coordinate.sortnet_argsort(x, axis=-1)),
+            np.asarray(jnp.argsort(x.astype(jnp.float32), axis=-1,
+                                   stable=True)))
+
+    def test_row_sums_matches_chained_sorted_prefix(self):
+        x = self._keys(6, 14, seed=13)
+        k = 9
+        rows = np.asarray(jnp.sort(x, axis=-1))
+        acc = rows[:, 0]
+        for i in range(1, k):
+            acc = acc + rows[:, i]  # same chain shape as the kernel
+        np.testing.assert_array_equal(
+            np.asarray(coordinate.sortnet_row_sums(x, k, axis=-1)), acc)
+
+    def test_bounded_by_max_sort_n_exact_message(self):
+        n = coordinate.MAX_SORT_N + 1
+        with pytest.raises(ValueError, match=(
+                rf"sorting-network path is bounded by "
+                rf"MAX_SORT_N={coordinate.MAX_SORT_N}, got n={n}; use the "
+                rf"XLA sort or bucket hierarchically")):
+            coordinate.sortnet_argsort(np.zeros((2, n), np.float32))
+        with pytest.raises(ValueError, match="MAX_SORT_N"):
+            coordinate.sortnet_row_sums(np.zeros((n, 2), np.float32).T, 3)
+
+    def test_top_m_and_row_sums_validate_bounds(self):
+        x = np.zeros((3, 8), np.float32)
+        with pytest.raises(ValueError, match=r"m must be in \[1, 8\]"):
+            coordinate.sortnet_top_m(x, 0)
+        with pytest.raises(ValueError, match=r"k must be in \[1, 8\]"):
+            coordinate.sortnet_row_sums(x, 9)

@@ -808,3 +808,88 @@ def test_exchange_bench_multiprocess():
         assert ratio >= 1.8, ratio
         for r in rows:
             assert r["round_s"] is None or r["round_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# decode_into (PR 19): the fused dequantize-into-fold entry point.
+
+
+class TestDecodeInto:
+    SCHEMES = ["f32", "bf16", "int8", "int4", "topk"]
+
+    def _vec(self, n, seed=0):
+        return np.random.default_rng(seed).normal(
+            size=n).astype(np.float32)
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    @pytest.mark.parametrize("n", [0, 1, 3, 1023, 1024, 1025])
+    def test_bitwise_parity_with_decode(self, scheme, n):
+        vec = self._vec(n, seed=n + 1)
+        frame = wire.encode(vec, dtype=scheme, plane=3)
+        want = wire.decode(frame, expect_plane=3, expect_elems=n)
+        out = np.full(n, np.float32(np.nan))
+        k = wire.decode_into(frame, out, expect_plane=3, expect_elems=n)
+        assert k == n
+        np.testing.assert_array_equal(out, want)
+
+    def test_oversized_target_decodes_prefix_only(self):
+        vec = self._vec(100, seed=9)
+        frame = wire.encode(vec, dtype="int8")
+        out = np.full(130, np.float32(7.5))
+        k = wire.decode_into(frame, out)  # max_elems defaults to out.size
+        assert k == 100
+        np.testing.assert_array_equal(out[:100], wire.decode(frame))
+        # the tail beyond the frame's claim is untouched
+        np.testing.assert_array_equal(out[100:], np.float32(7.5))
+
+    @pytest.mark.parametrize("corrupt", ["crc", "truncate", "elems",
+                                         "plane", "too_small"])
+    def test_errors_leave_target_untouched(self, corrupt):
+        vec = self._vec(64, seed=4)
+        frame = bytearray(wire.encode(vec, dtype="int8", plane=1))
+        sentinel = np.full(64, np.float32(-3.25))
+        out = sentinel.copy()
+        kwargs = {"expect_plane": 1, "expect_elems": 64}
+        if corrupt == "crc":
+            frame[-1] ^= 0x55
+        elif corrupt == "truncate":
+            frame = frame[:20]
+        elif corrupt == "elems":
+            kwargs["expect_elems"] = 63
+        elif corrupt == "plane":
+            kwargs["expect_plane"] = 2
+        else:
+            out = sentinel[:10].copy()
+            kwargs = {"expect_plane": 1}
+        with pytest.raises(wire.WireError):
+            wire.decode_into(bytes(frame), out, **kwargs)
+        np.testing.assert_array_equal(out, sentinel[:out.size])
+
+    def test_rejects_unusable_targets_loudly(self):
+        frame = wire.encode(self._vec(8))
+        with pytest.raises(TypeError, match="float32"):
+            wire.decode_into(frame, np.zeros(8, np.float64))
+        with pytest.raises(TypeError, match="1-D"):
+            wire.decode_into(frame, np.zeros((2, 4), np.float32))
+        ro = np.zeros(8, np.float32)
+        ro.flags.writeable = False
+        with pytest.raises(TypeError, match="writable"):
+            wire.decode_into(frame, ro)
+
+    def test_frame_elems_header_only_sizing(self):
+        frame = wire.encode(self._vec(321), dtype="int4")
+        assert wire.frame_elems(frame) == 321
+        with pytest.raises(wire.WireError):
+            wire.frame_elems(frame[:10])
+        bad = bytearray(frame)
+        bad[0] = 0x00  # break the magic
+        with pytest.raises(wire.WireError):
+            wire.frame_elems(bytes(bad))
+
+    def test_wire_fused_env_knob(self, monkeypatch):
+        monkeypatch.delenv("GARFIELD_WIRE_FUSED_DECODE", raising=False)
+        assert wire.wire_fused() is True  # default on
+        monkeypatch.setenv("GARFIELD_WIRE_FUSED_DECODE", "0")
+        assert wire.wire_fused() is False
+        monkeypatch.setenv("GARFIELD_WIRE_FUSED_DECODE", "on")
+        assert wire.wire_fused() is True
