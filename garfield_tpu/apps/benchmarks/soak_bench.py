@@ -1,0 +1,390 @@
+"""Sustained-load soak harness for the control plane (SOAKBENCH_r*).
+
+Hours-equivalent sustained rounds through the federated engine — fully
+in-process (no subprocess fleet: every scenario is deterministic and
+replayable, and nothing here needs the ``_RUN_LAST`` port discipline) —
+under the three stresses a production deployment actually meets, each a
+schema-v13 ``soak_bench`` row with the trace plane's round-latency
+p50/p95/p99 as the SLO columns (telemetry.hub.phase_stats over one
+``soak_round`` span per round):
+
+``steady``
+    The baseline: N rounds, nothing injected. Its percentiles are the
+    SLO floor the stress scenarios are read against.
+
+``rolling_restart``
+    Every ``--kill_every`` rounds the next shard (round-robin) is
+    KILLED MID-ROUND at a pinned ingest count and its standby promoted
+    (controlplane.promote_standby: span restored bitwise from the
+    round-(R-1) checkpoint, suspicion absorbed, epoch bumped), then the
+    interrupted round re-runs from scratch. Two claims are measured,
+    not asserted: ``kill_cost_rounds`` — the mean extra latency of a
+    kill round over the scenario's own clean-round p50, in rounds; the
+    handoff contract says ≤ 1 (one re-run) — and ``bitwise_equal`` —
+    the final model is bitwise identical to an undisturbed twin run
+    (failover costs latency, never trajectory).
+
+``partition``
+    Every ``--part_every`` rounds a partitioned sender — one still
+    holding the pre-change membership — delivers a frame stamped with a
+    stale epoch, plus one pre-epoch (v1) frame, plus a replayed stale
+    ``MembershipView``. All three must be attributable rejects
+    (``stale_rejects`` counts them; a miss raises) while the round
+    completes undisturbed on the fresh cohort's frames: a partition
+    costs the partitioned side its traffic, never the healthy side its
+    round.
+
+``churn``
+    Client churn + elasticity: a staleness policy drops/discounts a
+    rotating subset of the cohort every round (tags drive
+    ``CohortSampler.cohort_weights`` — stragglers past the cutoff leave
+    the round before planning), while a ``ShardAutoscaler`` with an
+    unreachable latency target splits the shard group under pressure
+    (each split is an epoch bump; ``resizes`` counts them, and refused
+    splits are rescinded — the satellite-2 contract, accounting-free).
+
+Environment knobs (CLI flags override): ``GARFIELD_SOAK_ROUNDS``
+(rounds per scenario), ``GARFIELD_SOAK_COHORT``, ``GARFIELD_SOAK_D``,
+``GARFIELD_SOAK_SHARDS``. The committed artifact runs the defaults
+(4 x 60 = 240 sustained rounds); the tier-1 smoke runs ``--rounds 6``
+in seconds.
+
+  python -m garfield_tpu.apps.benchmarks.soak_bench --json SOAKBENCH.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from ... import controlplane as cp
+from ... import federated as fed
+from ...telemetry import hub as tele_hub
+from ...telemetry import trace as tele_trace
+from ...utils import rounds as rounds_lib
+from ...utils import wire
+
+
+def _env_int(name, default):
+    v = os.environ.get(name, "").strip()
+    return int(v) if v else default
+
+
+class _NullCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+def _rows_for(n, d, round_, seed):
+    """The round's cohort gradients — deterministic in (seed, round) and
+    independent of everything else, so a killed-and-rerun round replays
+    the exact bytes and the bitwise twin-run comparison is meaningful."""
+    rng = np.random.default_rng([seed, 31, int(round_)])
+    return rng.normal(size=(n, d)).astype(np.float32)
+
+
+class _Soak:
+    """One scenario's engine + bookkeeping (fresh hub and trace stream
+    per scenario, so each row's percentiles are its own)."""
+
+    def __init__(self, args, name, *, shards=None, staleness=None,
+                 ckpt_dir=None):
+        self.args = args
+        self.name = name
+        self.hub = tele_hub.MetricsHub()
+        self._prev_hub = tele_hub.install(self.hub)
+        tele_trace.enable(who=f"soak-{name}")
+        self.sampler = fed.CohortSampler(
+            args.population, args.cohort, seed=args.seed,
+            byz_frac=args.byz_frac, staleness=staleness,
+        )
+        model0 = np.random.default_rng(args.seed).normal(
+            size=args.d).astype(np.float32)
+        self.engine = fed.FedRoundEngine(
+            model0, args.shards if shards is None else shards,
+            self.sampler, lr=0.05, telemetry=True,
+            checkpoint_dir=ckpt_dir, epoch=1,
+        )
+        self.walls = []        # clean-round walls
+        self.kill_walls = []   # killed-round walls (incl. the re-run)
+        self.stale_rejects = 0
+        self.failovers = 0
+        self.partitions = 0
+
+    def close(self):
+        tele_trace.disable()
+        tele_hub.install(self._prev_hub)
+
+    def run_round(self, r, *, tags=None, kill_shard=None, record=True):
+        """One soak round; with ``kill_shard`` the shard dies mid-round
+        at a pinned ingest count and the round re-runs after handoff.
+        ``record=False`` runs the round but keeps it out of the span
+        stream and the wall lists — round 0 is a compile warmup in
+        every scenario (fed_bench's convention), so the committed
+        percentiles are steady-state, not jit-compile tails."""
+        t0 = time.perf_counter()
+        span = (tele_trace.span("soak_round", scenario=self.name, step=r)
+                if record else _NULL)
+        with span:
+            active, _f = self.engine.begin_round(tags)
+            rows = _rows_for(active.size, self.args.d, r, self.args.seed)
+            if kill_shard is not None:
+                # Pinned mid-round death: half the cohort is already in
+                # every reducer when shard ``kill_shard`` dies. The
+                # handoff restores its span from the round-(r-1)
+                # checkpoint and the WHOLE round re-runs (mid-round fold
+                # state is deliberately never checkpointed — see
+                # controlplane/failover.py).
+                self.engine.ingest_rows(rows[: active.size // 2])
+                _, rerun = cp.promote_standby(self.engine, kill_shard)
+                assert rerun == r, (rerun, r)
+                self.failovers += 1
+                active2, _ = self.engine.begin_round(tags)
+                assert np.array_equal(active, active2)
+            self.engine.ingest_rows(rows)
+            self.engine.finish_round()
+        wall = time.perf_counter() - t0
+        if record:
+            (self.kill_walls if kill_shard is not None
+             else self.walls).append(wall)
+        return wall
+
+    def inject_partition(self, r):
+        """One partitioned sender's worth of stale traffic: a frame
+        stamped one epoch behind, a pre-epoch v1 frame, and a replayed
+        stale membership view — three attributable rejects or bust."""
+        sh = self.engine.shards[r % self.engine.spec.num_shards]
+        row = np.zeros(sh.d_shard, np.float32)
+        stale = wire.encode(row, plane=sh.shard, epoch=sh.epoch - 1)
+        v1 = wire.encode(row, plane=sh.shard)  # epoch-less pre-epoch frame
+        for frame in (stale, v1):
+            try:
+                sh.push_frame(frame)
+            except wire.WireError:
+                self.stale_rejects += 1
+            else:
+                raise AssertionError(
+                    f"stale/pre-epoch frame ACCEPTED by shard {sh.shard} "
+                    f"at epoch {sh.epoch}"
+                )
+        # The membership-record replay ban, same partition story: the
+        # partitioned side re-publishes the view it still holds.
+        cur = cp.MembershipView.for_engine(self.engine)
+        directory = cp.MembershipDirectory(cur)
+        old = cp.MembershipView(max(0, cur.epoch - 1), cur.d,
+                                list(cur.seats))
+        try:
+            directory.install_frame(old.encode())
+        except cp.StaleViewError:
+            self.stale_rejects += 1
+        else:
+            raise AssertionError("stale membership view ACCEPTED")
+        self.partitions += 1
+
+    def row(self, check, **extra):
+        st = (self.hub.phase_stats() or {}).get("soak_round")
+        n_rounds = len(self.walls) + len(self.kill_walls)
+        out = {
+            "check": check, "rounds": n_rounds,
+            "d": self.args.d, "shards": self.engine.spec.num_shards,
+            "cohort": self.args.cohort,
+            "population": self.args.population,
+            "p50_s": round(st["p50_s"], 6), "p95_s": round(st["p95_s"], 6),
+            "p99_s": round(st["p99_s"], 6),
+            "mean_s": round(st["mean_s"], 6),
+            "wall_s": round(sum(self.walls) + sum(self.kill_walls), 4),
+            "failovers": self.failovers,
+            "partitions": self.partitions,
+            "stale_rejects": self.stale_rejects,
+            "epoch_final": int(self.engine.epoch),
+        }
+        out.update(extra)
+        return out
+
+
+# --- scenarios ---------------------------------------------------------------
+
+
+def steady(args):
+    with tempfile.TemporaryDirectory() as td:
+        s = _Soak(args, "steady", ckpt_dir=td)
+        try:
+            for r in range(args.rounds + 1):
+                s.run_round(r, record=r > 0)
+            return s.row("steady")
+        finally:
+            s.close()
+
+
+def rolling_restart(args):
+    # The undisturbed twin first: same seeds, same rounds, no kills.
+    with tempfile.TemporaryDirectory() as td:
+        twin = _Soak(args, "rolling_twin", ckpt_dir=td)
+        try:
+            for r in range(args.rounds + 1):
+                twin.run_round(r, record=r > 0)
+            twin_model = twin.engine.model.copy()
+        finally:
+            twin.close()
+    with tempfile.TemporaryDirectory() as td:
+        s = _Soak(args, "rolling_restart", ckpt_dir=td)
+        try:
+            for r in range(args.rounds + 1):
+                kill = None
+                if r and r % args.kill_every == 0:
+                    # Round-robin victim; r >= 1 so a checkpoint exists.
+                    kill = (r // args.kill_every - 1) \
+                        % s.engine.spec.num_shards
+                s.run_round(r, kill_shard=kill, record=r > 0)
+            p50 = float(np.percentile(np.asarray(s.walls), 50))
+            kill_cost = (
+                float(np.mean(np.asarray(s.kill_walls)) / p50) - 1.0
+                if s.kill_walls else None
+            )
+            return s.row(
+                "rolling_restart",
+                kill_cost_rounds=(
+                    None if kill_cost is None else round(kill_cost, 3)
+                ),
+                bitwise_equal=bool(
+                    np.array_equal(s.engine.model, twin_model)
+                ),
+            )
+        finally:
+            s.close()
+
+
+def partition(args):
+    with tempfile.TemporaryDirectory() as td:
+        s = _Soak(args, "partition", ckpt_dir=td)
+        try:
+            for r in range(args.rounds + 1):
+                if r and r % args.part_every == 0:
+                    s.inject_partition(r)
+                s.run_round(r, record=r > 0)
+            return s.row("partition")
+        finally:
+            s.close()
+
+
+def churn(args):
+    policy = rounds_lib.StalenessPolicy(max_staleness=2, decay=0.9)
+    with tempfile.TemporaryDirectory() as td:
+        s = _Soak(args, "churn", staleness=policy, ckpt_dir=td)
+        # Unreachable latency target: every full window reads as
+        # pressure, so the autoscaler splits as often as its cooldown
+        # allows — the sustained-split path, with refusals rescinded
+        # once the group hits a cap.
+        scaler = cp.ShardAutoscaler(
+            s.engine, target_rate=1e9, max_shards=args.churn_max_shards,
+            window=4, cooldown=4,
+        )
+        dropped = 0
+        try:
+            rng = np.random.default_rng([args.seed, 97])
+            for r in range(args.rounds + 1):
+                # A rotating straggler subset: ~1/4 of the population is
+                # 1-4 rounds behind this round; past the cutoff (2) they
+                # are dropped before planning.
+                lag_ids = rng.choice(args.population,
+                                     args.population // 4, replace=False)
+                lag = rng.integers(1, 5, lag_ids.size)
+                tags = {int(c): int(r - t)
+                        for c, t in zip(lag_ids.tolist(), lag.tolist())}
+                wall = s.run_round(r, tags=tags, record=r > 0)
+                if r == 0:
+                    continue
+                dropped += int(s.engine._dropped.size)
+                scaler.observe(wall)
+            return s.row(
+                "churn",
+                resizes=scaler.splits + scaler.merges,
+                dropped_total=dropped,
+            )
+        finally:
+            s.close()
+
+
+# --- entry -------------------------------------------------------------------
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Control-plane soak harness (SOAKBENCH_r*)"
+    )
+    p.add_argument("--rounds", type=int,
+                   default=_env_int("GARFIELD_SOAK_ROUNDS", 60),
+                   help="Sustained rounds PER scenario.")
+    p.add_argument("--cohort", type=int,
+                   default=_env_int("GARFIELD_SOAK_COHORT", 64))
+    p.add_argument("--population", type=int, default=None,
+                   help="Client population (default 4x cohort).")
+    p.add_argument("--d", type=int,
+                   default=_env_int("GARFIELD_SOAK_D", 2048))
+    p.add_argument("--shards", type=int,
+                   default=_env_int("GARFIELD_SOAK_SHARDS", 4))
+    p.add_argument("--seed", type=int, default=20260807)
+    p.add_argument("--byz_frac", type=float, default=0.01)
+    p.add_argument("--kill_every", type=int, default=10,
+                   help="rolling_restart: kill a shard mid-round every "
+                        "K rounds.")
+    p.add_argument("--part_every", type=int, default=8,
+                   help="partition: inject stale-epoch traffic every K "
+                        "rounds.")
+    p.add_argument("--churn_max_shards", type=int, default=8,
+                   help="churn: autoscaler split ceiling (< the wire "
+                        "nibble's 16, so refusals exercise rescind).")
+    p.add_argument("--scenarios", nargs="*", type=str,
+                   default=["steady", "rolling_restart", "partition",
+                            "churn"])
+    p.add_argument("--json", type=str, default=None,
+                   help="Dump rows to this JSON file + the schema-v13 "
+                        "JSONL twin (soak_bench records).")
+    args = p.parse_args(argv)
+    if args.population is None:
+        args.population = 4 * args.cohort
+
+    fns = {"steady": steady, "rolling_restart": rolling_restart,
+           "partition": partition, "churn": churn}
+    rows = []
+    for name in args.scenarios:
+        row = fns[name](args)
+        rows.append(row)
+        extra = ""
+        if row.get("kill_cost_rounds") is not None:
+            extra += (f" kill_cost={row['kill_cost_rounds']}r "
+                      f"bitwise={row['bitwise_equal']}")
+        if row.get("resizes") is not None:
+            extra += f" resizes={row['resizes']}"
+        print(f"{name}: rounds={row['rounds']} "
+              f"p50={row['p50_s'] * 1e3:.1f}ms "
+              f"p95={row['p95_s'] * 1e3:.1f}ms "
+              f"p99={row['p99_s'] * 1e3:.1f}ms "
+              f"failovers={row['failovers']} "
+              f"stale_rejects={row['stale_rejects']} "
+              f"epoch={row['epoch_final']}{extra}", flush=True)
+
+    if args.json:
+        with open(args.json, "w") as fp:
+            json.dump(rows, fp, indent=1)
+        from ...telemetry import exporters
+
+        jsonl_path = os.path.splitext(args.json)[0] + ".jsonl"
+        with exporters.JsonlExporter(jsonl_path) as exp:
+            for row in rows:
+                exp.write(exporters.make_record("soak_bench", **row))
+    return rows
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

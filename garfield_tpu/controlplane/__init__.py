@@ -1,0 +1,46 @@
+"""Control plane: membership, failover, shard autoscaling (DESIGN.md §22).
+
+The deployment layer over the federated shard plane: epoch-numbered
+membership views distributed as CRC-tagged records (``membership``),
+heartbeat failure detection + checkpointed span handoff so a shard
+death costs one round (``failover``), and latency-driven span
+split/merge reusing the worker autoscaler's control law
+(``shardscale``). Every membership change — failover, split, merge —
+is exactly one epoch increment, stamped into every data-plane wire
+frame (utils/wire v2 header) so stale-membership traffic is an
+attributable reject, never a silent mis-fold.
+"""
+
+from .failover import (
+    EF_RESIDUAL_RESTORED,
+    HeartbeatMonitor,
+    heartbeat_interval_s,
+    promote_standby,
+    standby_shards,
+    tcp_probe,
+)
+from .membership import (
+    CONTROL_PLANE,
+    MembershipDirectory,
+    MembershipView,
+    Seat,
+    StaleViewError,
+    ViewError,
+)
+from .shardscale import ShardAutoscaler
+
+__all__ = [
+    "CONTROL_PLANE",
+    "EF_RESIDUAL_RESTORED",
+    "HeartbeatMonitor",
+    "MembershipDirectory",
+    "MembershipView",
+    "Seat",
+    "ShardAutoscaler",
+    "StaleViewError",
+    "ViewError",
+    "heartbeat_interval_s",
+    "promote_standby",
+    "standby_shards",
+    "tcp_probe",
+]

@@ -1,0 +1,257 @@
+"""Shard failure detection + checkpointed span handoff.
+
+The Garfield paper tolerates f Byzantine OR CRASHED parameter servers
+by replicating the full model (PAPER.md's f_ps axis); the sharded
+federated plane (DESIGN.md §19) has no replicas — a shard owns its
+span exclusively, so before this module a mid-round shard death cost
+the whole run. With the per-span checkpoints already on disk
+(federated/sharding.save_sharded, written every round by
+``FedRoundEngine.save_checkpoint``), it should cost exactly ONE round,
+and this module is the machinery:
+
+- ``HeartbeatMonitor`` — failure detection: per-target probes (a TCP
+  connect by default, ``tcp_probe``) on a fixed cadence with bounded
+  in-probe retries and exponential backoff, so one dropped SYN is not
+  a failover but ``retries`` consecutive losses are. Declaring death
+  after R missed probes at interval T bounds detection latency at
+  ~R*T + backoff — the knobs ride ``GARFIELD_HEARTBEAT_MS``.
+- ``promote_standby`` — the handoff: replace a dead shard's server
+  with a standby restored from the span's checkpoint. The standby gets
+  the span's model bytes (bitwise — ``sharding.restore_span``), the
+  round number it may serve (``ShardServer.mark_restored``: serving
+  any other round is a loud refusal, the satellite-1 contract), the
+  membership epoch BUMPED by one (stale-epoch frames from anyone still
+  talking to the dead membership are attributable wire rejects), and
+  the checkpointed per-client suspicion absorbed max-merge into the
+  hub — an epoch-timed attacker cannot launder its exclusion history
+  by crashing the shard that remembered it (DESIGN.md §22).
+
+What is deliberately NOT restored: the wire ``ErrorFeedback``
+residual. Zero-rebuild on restart is the recorded PR 14 decision
+(utils/wire.ErrorFeedback docstring): the residual is a bounded
+one-step correction, so dropping it costs one step of compensation —
+cheaper and simpler than checkpointing a per-sender dict every round,
+and pinned here (``EF_RESIDUAL_RESTORED = False`` + the controlplane
+test) so a future round changes it explicitly or not at all.
+
+The interrupted round is RE-RUN, not resumed: mid-round reducer state
+(wave buffers, partial folds) is deliberately never checkpointed —
+its arrival-order dependence would make a resumed fold bitwise
+unverifiable. Re-running from the round-(R-1) checkpoint keeps the
+S=1 bitwise anchor intact across the failure path (the fed test
+suite pins a killed-and-handed-off round's aggregate bitwise equal to
+an undisturbed run), which is the whole auditability point.
+"""
+
+import os
+import socket
+import time
+
+from ..federated import sharding
+from ..telemetry import hub as tele_hub
+
+__all__ = [
+    "EF_RESIDUAL_RESTORED",
+    "heartbeat_interval_s",
+    "standby_shards",
+    "tcp_probe",
+    "HeartbeatMonitor",
+    "promote_standby",
+]
+
+# The PR 14 restart decision, pinned as data (see module docstring):
+# wire ErrorFeedback residuals are rebuilt at zero on any restart or
+# handoff — a handoff must NOT try to restore them.
+EF_RESIDUAL_RESTORED = False
+
+_DEFAULT_HEARTBEAT_MS = 100
+
+
+def heartbeat_interval_s():
+    """The probe cadence in seconds (``GARFIELD_HEARTBEAT_MS``, default
+    100 ms — an order above a LAN RTT, an order under a round)."""
+    v = os.environ.get("GARFIELD_HEARTBEAT_MS", "").strip()
+    if not v:
+        return _DEFAULT_HEARTBEAT_MS / 1000.0
+    try:
+        ms = float(v)
+    except ValueError:
+        raise ValueError(
+            f"GARFIELD_HEARTBEAT_MS must be a number of milliseconds, "
+            f"got {v!r}"
+        )
+    if ms <= 0:
+        raise ValueError(
+            f"GARFIELD_HEARTBEAT_MS must be > 0, got {ms}"
+        )
+    return ms / 1000.0
+
+
+def standby_shards():
+    """How many standby shard servers a deployment keeps warm
+    (``GARFIELD_STANDBY_SHARDS``, default 1). Zero disables failover —
+    a shard death is then terminal, the pre-control-plane behavior."""
+    v = os.environ.get("GARFIELD_STANDBY_SHARDS", "1").strip()
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(
+            f"GARFIELD_STANDBY_SHARDS must be a non-negative integer, "
+            f"got {v!r}"
+        )
+    if n < 0:
+        raise ValueError(
+            f"GARFIELD_STANDBY_SHARDS must be >= 0, got {n}"
+        )
+    return n
+
+
+def tcp_probe(host, port, timeout_s=0.25):
+    """One liveness probe on the TCP plane: can the target's exchange
+    listener accept a connection within ``timeout_s``? The connection
+    is closed immediately — ``PeerExchange``'s accept loop tolerates
+    a no-payload connection (reader sees EOF before a transport
+    header), so probing is free for the probed."""
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=float(timeout_s)):
+            return True
+    except OSError:
+        return False
+
+
+class HeartbeatMonitor:
+    """Cadenced failure detection over a set of probe targets.
+
+    ``targets`` maps a key (shard id, rank...) to whatever the
+    ``probe`` callable takes — ``(host, port)`` for the default
+    ``tcp_probe``. A target is DOWN after ``retries`` consecutive
+    failed probes; within one ``poll`` the probe is retried up to
+    ``retries`` times with exponential backoff (``backoff_s * 2**i``)
+    before the miss is counted, so a single dropped SYN costs
+    milliseconds, not a failover. ``poll()`` is synchronous and
+    deterministic (tests drive it round-by-round); a deployment loop
+    calls it once per ``interval_s`` (``run_once`` sleeps the
+    remainder). ``on_down`` fires exactly once per death — a target
+    revived via ``revive`` re-arms it.
+    """
+
+    def __init__(self, targets, *, probe=None, interval_s=None,
+                 retries=3, backoff_s=0.01, on_down=None):
+        self.targets = dict(targets)
+        self.probe = tcp_probe if probe is None else probe
+        self.interval_s = (
+            heartbeat_interval_s() if interval_s is None
+            else float(interval_s)
+        )
+        self.retries = int(retries)
+        if self.retries < 1:
+            raise ValueError(f"retries must be >= 1, got {retries}")
+        self.backoff_s = float(backoff_s)
+        self.on_down = on_down
+        self.misses = {k: 0 for k in self.targets}
+        self.down = set()
+        self.probes = 0
+
+    def _probe_with_retry(self, target):
+        for i in range(self.retries):
+            self.probes += 1
+            try:
+                if self.probe(*target) if isinstance(target, tuple) \
+                        else self.probe(target):
+                    return True
+            except Exception:
+                pass  # a raising probe is a failed probe, not a crash
+            if i + 1 < self.retries and self.backoff_s > 0:
+                time.sleep(self.backoff_s * (2 ** i))
+        return False
+
+    def poll(self):
+        """One probe sweep; returns the keys newly declared down."""
+        died = []
+        for key, target in self.targets.items():
+            if key in self.down:
+                continue
+            if self._probe_with_retry(target):
+                self.misses[key] = 0
+                continue
+            self.misses[key] += 1
+            if self.misses[key] >= 1:  # retried inside _probe_with_retry
+                self.down.add(key)
+                died.append(key)
+                if self.on_down is not None:
+                    self.on_down(key)
+        return died
+
+    def revive(self, key, target=None):
+        """Re-arm a key after its standby took over (or the target was
+        restarted) — the monitor watches the NEW incarnation."""
+        if target is not None:
+            self.targets[key] = target
+        self.down.discard(key)
+        self.misses[key] = 0
+
+    def run_once(self):
+        """One cadence tick: poll, then sleep out the interval."""
+        t0 = time.perf_counter()
+        died = self.poll()
+        rest = self.interval_s - (time.perf_counter() - t0)
+        if rest > 0 and not died:
+            time.sleep(rest)
+        return died
+
+
+def promote_standby(engine, shard, *, step=None):
+    """Hand a dead shard's span to a standby, mid-round.
+
+    Restores span ``shard`` of ``engine`` from the newest complete
+    checkpoint (or ``step``): a fresh ``ShardServer`` over the same
+    span, the span's model bytes restored bitwise from disk
+    (``sharding.restore_span`` — the engine's in-memory span may have
+    been half-updated by the round in flight), the control record's
+    suspicion absorbed max-merge, the membership epoch bumped (action
+    ``failover``), and the standby pinned to the one round it may
+    serve (``mark_restored`` — the interrupted round, which the caller
+    re-runs). Returns ``(server, round_to_rerun)``.
+
+    ErrorFeedback residuals are NOT restored — see the module
+    docstring and ``EF_RESIDUAL_RESTORED``.
+    """
+    if engine._ckpt_dir is None:
+        raise RuntimeError(
+            "cannot promote a standby: the engine has no checkpoint_dir "
+            "(per-span checkpoints are the handoff substrate)"
+        )
+    s = sharding.shard_plane(shard, engine.spec.num_shards)
+    complete = set(sharding.sharded_steps(engine._ckpt_dir, engine.spec))
+    complete &= set(engine.control_steps())
+    if step is None:
+        if not complete:
+            raise FileNotFoundError(
+                f"no complete checkpoint under {engine._ckpt_dir} to "
+                f"hand shard {s} off from"
+            )
+        step = max(complete)
+    elif int(step) not in complete:
+        raise FileNotFoundError(
+            f"round {step} has no complete checkpoint under "
+            f"{engine._ckpt_dir}"
+        )
+    span_model = sharding.restore_span(
+        engine._ckpt_dir, engine.spec, s, int(step)
+    )
+    ctl = engine.load_control(int(step))
+    rerun = int(ctl["round"]) + 1
+    lo, hi = engine.spec.spans[s]
+    engine.model[lo:hi] = span_model  # bitwise: a pure span copy
+    hub = tele_hub.current()
+    if hub is not None and ctl.get("suspicion"):
+        hub.absorb_client_suspicion({
+            int(cid): (float(o), float(e))
+            for cid, (o, e) in ctl["suspicion"].items()
+        })
+    engine.bump_epoch("failover", shard=s)
+    server = engine.build_shard(s)
+    server.mark_restored(rerun)
+    engine.shards[s] = server
+    return server, rerun

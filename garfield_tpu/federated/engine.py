@@ -39,6 +39,8 @@ into client-id-keyed decayed suspicion (the score resampling cannot
 launder).
 """
 
+import json
+import os
 import time
 
 import numpy as np
@@ -67,7 +69,7 @@ class ShardServer:
 
     def __init__(self, shard, spec, *, bucket_gar="krum", top_gar=None,
                  bucket_size=None, levels="auto", wave_buckets=8,
-                 audit=False):
+                 audit=False, epoch=None):
         self.shard = sharding.shard_plane(shard, spec.num_shards)
         self.spec = spec
         self.d_shard = spec.width(self.shard)
@@ -80,14 +82,51 @@ class ShardServer:
         self.wire_bytes_in = 0
         self._fused = wire.wire_fused()
         self._scratch = None
+        # Membership epoch this shard serves (controlplane, DESIGN.md
+        # §22): None = pre-epoch deployment, frames are not
+        # epoch-checked. When set, every wire frame must carry exactly
+        # this epoch (wire.decode's expect_epoch) — a stale-epoch frame
+        # is the same attributable reject as a cross-shard stamp.
+        self.epoch = None if epoch is None else wire.check_epoch(epoch)
+        # Round this shard is allowed to serve next after a checkpoint
+        # restore (mark_restored) — None once live again.
+        self._expect_round = None
 
     # -- round lifecycle ----------------------------------------------------
+
+    def mark_restored(self, next_round):
+        """Pin the ONLY round this shard may serve next: it was just
+        restored from the span checkpoint saved after round
+        ``next_round - 1`` finished, so ``next_round`` is the one round
+        its state is valid for. ``begin_round`` for any other round
+        refuses loudly (see there); serving the pinned round clears the
+        pin — from then on the shard is live and carries its own
+        state."""
+        self._expect_round = int(next_round)
 
     def begin_round(self, round_, n, f):
         """Arm the shard's reducer for ``n`` active cohort members at
         the priced budget ``f``. Reuses the previous round's wave
         buffers when (n, f) repeat — at bench scale the reallocation is
-        measurable, and plan identity keeps the fold programs cached."""
+        measurable, and plan identity keeps the fold programs cached.
+
+        A RESTORED shard (``mark_restored``) serves exactly the round
+        after its checkpoint: round state is rebuilt from scratch here
+        every round, so nothing else would catch a driver resuming at
+        the wrong round — the shard would silently fold rows against a
+        stale span and broadcast garbage with round-R labels. Refusing
+        is the loud form of "I have no span checkpoint for that
+        round"."""
+        if self._expect_round is not None \
+                and int(round_) != self._expect_round:
+            raise RuntimeError(
+                f"shard {self.shard} was restored from its round "
+                f"{self._expect_round - 1} span checkpoint and can only "
+                f"serve round {self._expect_round}; asked to begin round "
+                f"{int(round_)}, for which it has no span checkpoint — "
+                "refusing loudly instead of serving a stale span"
+            )
+        self._expect_round = None
         if self._red is not None and self._red.n == int(n) \
                 and self._red.f == int(f):
             self._red.reset()
@@ -132,11 +171,12 @@ class ShardServer:
             if self._scratch is None or self._scratch.size < claim:
                 self._scratch = np.empty(claim, np.float32)
             k = wire.decode_into(buf, self._scratch,
-                                 expect_plane=self.shard, max_elems=bound)
+                                 expect_plane=self.shard, max_elems=bound,
+                                 expect_epoch=self.epoch)
             vec = self._scratch[:k]
         else:
             vec = wire.decode(buf, expect_plane=self.shard,
-                              max_elems=bound)
+                              max_elems=bound, expect_epoch=self.epoch)
         if vec.size % self.d_shard:
             raise wire.WireError(
                 f"shard {self.shard} frame has {vec.size} elements — "
@@ -173,19 +213,31 @@ class FedRoundEngine:
     def __init__(self, model_vec, num_shards, sampler, *,
                  bucket_gar="krum", top_gar=None, bucket_size=None,
                  levels="auto", wave_buckets=8, lr=0.1, audit=False,
-                 telemetry=False):
+                 telemetry=False, checkpoint_dir=None, max_to_keep=3,
+                 epoch=None):
         self.model = np.asarray(model_vec, np.float32).reshape(-1).copy()
         self.spec = sharding.plan_shards(self.model.size, num_shards)
         self.sampler = sampler
         self.lr = float(lr)
         self._audit = bool(audit)
         self._telemetry = bool(telemetry)
+        self._shard_cfg = dict(
+            bucket_gar=bucket_gar, top_gar=top_gar,
+            bucket_size=bucket_size, levels=levels,
+            wave_buckets=wave_buckets, audit=self._audit,
+        )
+        # Control plane (DESIGN.md §22): ``epoch`` arms membership-epoch
+        # enforcement — every shard decodes wire frames with
+        # expect_epoch, and each failover / split / merge bumps the
+        # epoch (``bump_epoch``). None keeps the pre-epoch wire format
+        # (committed FEDBENCH drivers send v1 frames).
+        self.epoch = None if epoch is None else wire.check_epoch(epoch)
+        self._ckpt_dir = (
+            None if checkpoint_dir is None else str(checkpoint_dir)
+        )
+        self._max_to_keep = int(max_to_keep)
         self.shards = [
-            ShardServer(s, self.spec, bucket_gar=bucket_gar,
-                        top_gar=top_gar, bucket_size=bucket_size,
-                        levels=levels, wave_buckets=wave_buckets,
-                        audit=self._audit)
-            for s in range(self.spec.num_shards)
+            self.build_shard(s) for s in range(self.spec.num_shards)
         ]
         self.round = 0
         self._active_ids = None
@@ -193,6 +245,15 @@ class FedRoundEngine:
         self._pos = None  # global id -> cohort arrival position
         self._t0 = None
         self.last_info = None
+
+    def build_shard(self, shard):
+        """A fresh ``ShardServer`` for span ``shard`` under the current
+        spec and deployment config — what __init__ composes, what a
+        failover standby promotion (controlplane/failover.py) and a
+        ``resize`` rebuild call."""
+        return ShardServer(
+            shard, self.spec, epoch=self.epoch, **self._shard_cfg
+        )
 
     # -- round lifecycle ----------------------------------------------------
 
@@ -216,6 +277,7 @@ class FedRoundEngine:
         self._dropped = dropped
         self._pos = {int(c): i for i, c in enumerate(active.tolist())}
         for sh in self.shards:
+            sh.epoch = self.epoch  # track bumps (failover/split/merge)
             sh.begin_round(self.round, active.size, f)
         self._f = f
         self._t0 = time.perf_counter()
@@ -327,5 +389,160 @@ class FedRoundEngine:
                     f_budget=int(self._f),
                 )
         self.last_info = info
+        if self._ckpt_dir is not None:
+            self.save_checkpoint()
         self.round += 1
         return info
+
+    # -- control plane: checkpoints, failover, membership -------------------
+
+    def _control_dir(self):
+        return os.path.join(self._ckpt_dir, "control")
+
+    def save_checkpoint(self):
+        """Checkpoint the just-finished round: one per-span checkpoint
+        per shard (sharding.save_sharded — in deployment each shard
+        process writes only its own span) plus one CONTROL record (round
+        number, membership epoch, and the hub's per-client suspicion
+        snapshot) so a failover handoff restores the span AND the
+        round/suspicion state an epoch-timed attacker would love to see
+        dropped (DESIGN.md §22). Called automatically from
+        ``finish_round`` when ``checkpoint_dir`` is set; the step key is
+        the round just finished."""
+        sharding.save_sharded(
+            self._ckpt_dir, self.round, self.model, self.spec,
+            max_to_keep=self._max_to_keep,
+        )
+        hub = tele_hub.current()
+        snap = hub.client_suspicion_snapshot() if hub is not None else {}
+        rec = {
+            "round": int(self.round),
+            "epoch": None if self.epoch is None else int(self.epoch),
+            "num_shards": int(self.spec.num_shards),
+            "suspicion": {
+                str(cid): [float(o), float(e)]
+                for cid, (o, e) in snap.items()
+            },
+        }
+        # The control record is tiny host-side metadata with
+        # variable-length content — a plain JSON file with an atomic
+        # replace, not a Checkpointer (orbax restore needs fixed
+        # shapes), GC'd to the same history bound as the span files.
+        cdir = self._control_dir()
+        os.makedirs(cdir, exist_ok=True)
+        path = os.path.join(cdir, f"ctl_{int(self.round)}.json")
+        with open(path + ".tmp", "w") as fp:
+            json.dump(rec, fp)
+        os.replace(path + ".tmp", path)
+        for st in self.control_steps()[: -self._max_to_keep]:
+            os.remove(os.path.join(cdir, f"ctl_{st}.json"))
+
+    def control_steps(self):
+        """Sorted steps with a control record (see ``save_checkpoint``)."""
+        cdir = self._control_dir()
+        if not os.path.isdir(cdir):
+            return []
+        return sorted(
+            int(n[4:-5]) for n in os.listdir(cdir)
+            if n.startswith("ctl_") and n.endswith(".json")
+        )
+
+    def load_control(self, step):
+        """The control record saved at ``step`` (round/epoch/suspicion)."""
+        with open(os.path.join(
+            self._control_dir(), f"ctl_{int(step)}.json"
+        )) as fp:
+            return json.load(fp)
+
+    def resume(self, step=None):
+        """Restore the newest COMPLETE checkpoint — a step every span
+        AND the control record agree on (a torn save never restores
+        mixed rounds) — and pin every shard to the one round it can now
+        serve. Returns the restored round number R; the next
+        ``begin_round`` must be for round R + 1 (any other round is the
+        loud ShardServer.begin_round refusal — the resumed engine has
+        no span checkpoint for it). The hub (when installed) absorbs
+        the checkpointed per-client suspicion via max-merge, so a
+        crash/restore cycle cannot launder exclusion history."""
+        if self._ckpt_dir is None:
+            raise RuntimeError("engine has no checkpoint_dir to resume from")
+        complete = set(sharding.sharded_steps(self._ckpt_dir, self.spec))
+        complete &= set(self.control_steps())
+        if step is None:
+            if not complete:
+                raise FileNotFoundError(
+                    f"no complete checkpoint (all {self.spec.num_shards} "
+                    f"spans + control record) under {self._ckpt_dir}"
+                )
+            step = max(complete)
+        elif int(step) not in complete:
+            raise FileNotFoundError(
+                f"round {step} has no complete checkpoint under "
+                f"{self._ckpt_dir} (complete: {sorted(complete)})"
+            )
+        self.model[:] = sharding.restore_sharded(
+            self._ckpt_dir, self.spec, step=int(step)
+        )
+        ctl = self.load_control(step)
+        if int(ctl["round"]) != int(step):
+            raise ValueError(
+                f"control record at step {step} claims round "
+                f"{ctl['round']} — torn control plane"
+            )
+        self.round = int(step) + 1
+        if ctl.get("epoch") is not None:
+            self.epoch = wire.check_epoch(int(ctl["epoch"]))
+        hub = tele_hub.current()
+        if hub is not None and ctl.get("suspicion"):
+            hub.absorb_client_suspicion({
+                int(cid): (float(o), float(e))
+                for cid, (o, e) in ctl["suspicion"].items()
+            })
+        for sh in self.shards:
+            sh.epoch = self.epoch
+            sh.mark_restored(self.round)
+        return int(step)
+
+    def bump_epoch(self, action, *, shard=None):
+        """Advance the membership epoch by exactly one — every
+        failover, split or merge is one epoch, so a frame stamped with
+        any previous epoch is attributably stale (wire expect_epoch).
+        Emits the v13 ``membership`` telemetry event. No-op epoch-wise
+        when epoch enforcement is off (pre-epoch deployment), but the
+        event still lands so the action is visible."""
+        if self.epoch is not None:
+            self.epoch = wire.check_epoch(self.epoch + 1)
+            for sh in self.shards:
+                sh.epoch = self.epoch
+        if self._telemetry:
+            tele_hub.emit_event(
+                "membership",
+                epoch=None if self.epoch is None else int(self.epoch),
+                action=str(action),
+                shard=None if shard is None else int(shard),
+                num_shards=int(self.spec.num_shards),
+                step=int(self.round),
+            )
+        return self.epoch
+
+    def resize(self, num_shards):
+        """Split/merge the shard group to ``num_shards`` spans BETWEEN
+        rounds (the shard autoscaler's apply half,
+        controlplane/shardscale.py): re-plan the contiguous balanced
+        partition, rebuild every ShardServer over the new spans, bump
+        the membership epoch once. The model vector itself is
+        untouched — a repartition moves span boundaries, not bytes.
+        Raises (and changes nothing) when the resize is impossible:
+        past the wire header's 16 shard slots, or more shards than
+        parameters — callers rescind the controller action on that
+        refusal (utils/autoscale.rescind)."""
+        num_shards = int(num_shards)
+        if num_shards == self.spec.num_shards:
+            return self.spec
+        grew = num_shards > self.spec.num_shards
+        self.spec = sharding.plan_shards(self.model.size, num_shards)
+        self.shards = [
+            self.build_shard(s) for s in range(self.spec.num_shards)
+        ]
+        self.bump_epoch("split" if grew else "merge")
+        return self.spec

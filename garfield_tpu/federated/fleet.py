@@ -132,6 +132,12 @@ class ClientFleet:
             while free in live:
                 free += 1
             if free >= self.cfg.max_workers:
+                # Refused spawn: every driver index is occupied. The
+                # controller already charged its cooldown for the
+                # advice — rescind it, or the refusal silences scaling
+                # for a full cooldown + window refill with the fleet
+                # unchanged (the satellite-2 accounting bug).
+                self.controller.rescind()
                 return 0, None
             self.spawn(free)
             return action, free

@@ -48,7 +48,9 @@ __all__ = [
     "reassemble",
     "save_sharded",
     "restore_sharded",
+    "restore_span",
     "latest_sharded_step",
+    "sharded_steps",
 ]
 
 # The shard id rides the wire codec header's spare plane nibble (and the
@@ -174,20 +176,53 @@ def save_sharded(directory, step, model_vec, spec, *, shards=None,
         })
 
 
+def sharded_steps(directory, spec):
+    """Sorted steps present in EVERY shard subdirectory — the complete
+    (untorn) checkpoints. A step some shards are missing never appears:
+    restoring it would mix rounds across spans."""
+    steps = None
+    for s in range(spec.num_shards):
+        mine = set(ckpt_lib.Checkpointer(_shard_dir(directory, s)).steps())
+        steps = mine if steps is None else steps & mine
+        if not steps:
+            return []
+    return sorted(steps)
+
+
 def latest_sharded_step(directory, spec):
     """Newest step present in EVERY shard subdirectory (a torn save —
     some shards ahead of others — must not restore mixed rounds), or
     None when any shard has no checkpoint."""
-    steps = None
-    for s in range(spec.num_shards):
-        c = ckpt_lib.Checkpointer(_shard_dir(directory, s))
-        mine = set(c._pickle_steps()) if c._mgr is None else {
-            st for st in (c.latest_step(),) if st is not None
-        }
-        steps = mine if steps is None else steps & mine
-        if not steps:
-            return None
-    return max(steps)
+    steps = sharded_steps(directory, spec)
+    return steps[-1] if steps else None
+
+
+def restore_span(directory, spec, shard, step):
+    """ONE shard's span from its per-span checkpoint — the restore half
+    of the failover handoff (controlplane/failover.py): a standby
+    taking over span ``shard`` reads only that shard's subdirectory,
+    never the full model. Verifies the recorded span/meta against the
+    spec exactly like ``restore_sharded``. Returns the (d_s,) float32
+    span, bitwise the bytes ``save_sharded`` wrote."""
+    s = shard_plane(shard, spec.num_shards)
+    lo, hi = spec.spans[s]
+    like = {
+        "model": np.zeros(hi - lo, np.float32),
+        "span": np.zeros(2, np.int64),
+        "meta": np.zeros(2, np.int64),
+    }
+    state = ckpt_lib.Checkpointer(_shard_dir(directory, s)).restore(
+        like, step=int(step)
+    )
+    span = tuple(int(x) for x in np.asarray(state["span"]))
+    meta = tuple(int(x) for x in np.asarray(state["meta"]))
+    if span != (lo, hi) or meta != (spec.d, spec.num_shards):
+        raise ValueError(
+            f"shard {s} checkpoint was written for span {span} of a "
+            f"d={meta[0]}, S={meta[1]} model; the spec expects span "
+            f"({lo}, {hi}) of d={spec.d}, S={spec.num_shards}"
+        )
+    return np.asarray(state["model"], np.float32)
 
 
 def restore_sharded(directory, spec, step=None):
@@ -200,24 +235,7 @@ def restore_sharded(directory, spec, step=None):
         raise FileNotFoundError(
             f"no complete sharded checkpoint under {directory}"
         )
-    parts = []
-    for s in range(spec.num_shards):
-        lo, hi = spec.spans[s]
-        like = {
-            "model": np.zeros(hi - lo, np.float32),
-            "span": np.zeros(2, np.int64),
-            "meta": np.zeros(2, np.int64),
-        }
-        state = ckpt_lib.Checkpointer(_shard_dir(directory, s)).restore(
-            like, step=step
-        )
-        span = tuple(int(x) for x in np.asarray(state["span"]))
-        meta = tuple(int(x) for x in np.asarray(state["meta"]))
-        if span != (lo, hi) or meta != (spec.d, spec.num_shards):
-            raise ValueError(
-                f"shard {s} checkpoint was written for span {span} of a "
-                f"d={meta[0]}, S={meta[1]} model; the spec expects span "
-                f"({lo}, {hi}) of d={spec.d}, S={spec.num_shards}"
-            )
-        parts.append(np.asarray(state["model"], np.float32))
-    return reassemble(spec, parts)
+    return reassemble(spec, [
+        restore_span(directory, spec, s, step)
+        for s in range(spec.num_shards)
+    ])

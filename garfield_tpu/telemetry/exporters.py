@@ -134,11 +134,21 @@ SCHEMA = "garfield-telemetry"
 # and ``gar_bench`` rows may carry the --selection micro-mode fields
 # (``grid``, ``impl`` — sortnet vs xla_sort as explicit closures —
 # ``wave_buckets``, ``per_bucket_s``), all validated below.
-SCHEMA_VERSION = 12
+# v13 (round 20, the control plane — DESIGN.md §22): the ``membership``
+# EVENT (one membership change: the new ``epoch`` — or null on a
+# pre-epoch deployment — the ``action`` that caused it
+# (failover/split/merge), the affected ``shard`` when there is one, the
+# resulting ``num_shards``, and the round as ``step``), and the new
+# ``soak_bench`` kind (SOAKBENCH_r*'s rows: one sustained-load scenario
+# each — steady / rolling_restart / partition / churn — with round
+# counts, p50/p95/p99 round latency from the trace plane, the
+# failover/partition/epoch accounting, and the measured
+# ``kill_cost_rounds`` for the mid-round-kill SLO).
+SCHEMA_VERSION = 13
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
          "transfer_bench", "exchange_bench", "hier_bench", "span",
-         "defense_bench", "fed_bench")
+         "defense_bench", "fed_bench", "soak_bench")
 
 
 def make_record(kind, **fields):
@@ -484,6 +494,40 @@ def validate_record(rec):
                     _fail(
                         f"autoscale.{key} must be a number or null, "
                         f"got {val!r}"
+                    )
+        elif rec.get("event") == "membership":
+            # v13: one membership change (controlplane — DESIGN.md §22):
+            # every failover / split / merge is exactly one epoch bump,
+            # and this event is its audit trail.
+            if not isinstance(rec.get("action"), str) \
+                    or not rec["action"]:
+                _fail(
+                    f"membership.action must be a non-empty string, "
+                    f"got {rec.get('action')!r}"
+                )
+            ep = rec.get("epoch")
+            if ep is not None and (
+                not isinstance(ep, int) or isinstance(ep, bool) or ep < 0
+            ):
+                _fail(
+                    f"membership.epoch must be a non-negative int or "
+                    f"null (pre-epoch deployment), got {ep!r}"
+                )
+            ns = rec.get("num_shards")
+            if not isinstance(ns, int) or isinstance(ns, bool) or ns < 1:
+                _fail(
+                    f"membership.num_shards must be a positive int, "
+                    f"got {ns!r}"
+                )
+            for key in ("shard", "step"):
+                val = rec.get(key)
+                if val is not None and (
+                    not isinstance(val, int) or isinstance(val, bool)
+                    or val < 0
+                ):
+                    _fail(
+                        f"membership.{key} must be a non-negative int "
+                        f"or null, got {val!r}"
                     )
     elif kind == "span":
         # v5: one timed phase of a round (telemetry/trace.py).
@@ -871,6 +915,48 @@ def validate_record(rec):
             _fail(
                 f"fed_bench.peak_rss_bytes must be a non-negative int "
                 f"or null, got {rss!r}"
+            )
+    elif kind == "soak_bench":
+        # v13: one SOAKBENCH_r* scenario row — sustained rounds through
+        # the federated engine under control-plane stress (steady /
+        # rolling_restart / partition / churn), with the trace plane's
+        # round-latency percentiles as the SLO columns.
+        if not isinstance(rec.get("check"), str) or not rec["check"]:
+            _fail(
+                f"soak_bench.check must be a non-empty string, got "
+                f"{rec.get('check')!r}"
+            )
+        for key in ("rounds", "d", "shards", "cohort"):
+            val = rec.get(key)
+            if not isinstance(val, int) or isinstance(val, bool) \
+                    or val < 1:
+                _fail(
+                    f"soak_bench.{key} must be a positive int, got {val!r}"
+                )
+        for key in ("population", "failovers", "partitions", "resizes",
+                    "stale_rejects", "epoch_final", "dropped_total"):
+            val = rec.get(key)
+            if val is not None and (
+                not isinstance(val, int) or isinstance(val, bool)
+                or val < 0
+            ):
+                _fail(
+                    f"soak_bench.{key} must be a non-negative int or "
+                    f"null, got {val!r}"
+                )
+        for key in ("p50_s", "p95_s", "p99_s", "mean_s", "wall_s",
+                    "kill_cost_rounds"):
+            val = rec.get(key)
+            if val is not None and not _is_num(val):
+                _fail(
+                    f"soak_bench.{key} must be a number or null, "
+                    f"got {val!r}"
+                )
+        bw = rec.get("bitwise_equal")
+        if bw is not None and not isinstance(bw, bool):
+            _fail(
+                f"soak_bench.bitwise_equal must be a bool or null, "
+                f"got {bw!r}"
             )
     elif kind == "transfer_bench":
         for key in ("devices", "d"):

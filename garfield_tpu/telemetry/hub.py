@@ -522,6 +522,47 @@ class MetricsHub:
             return dict(top)
         return out
 
+    def client_suspicion_snapshot(self):
+        """The raw per-client suspicion accumulators
+        ({cid: (obs, exc)}), decayed to 'now' — what a shard failover
+        checkpoints so a handoff carries suspicion FORWARD
+        (controlplane/failover.py, DESIGN.md §22): an adaptive attacker
+        who times a crash must not get its exclusion history reset by
+        the standby's fresh hub. Empty dict before any cohort event."""
+        with self._lock:
+            now = self._cohort_events
+            out = {}
+            for cid, (obs, exc, last) in self._clients.items():
+                if self._halflife is not None and now > last:
+                    dk = self._susp_decay ** (now - last)
+                    obs, exc = obs * dk, exc * dk
+                out[int(cid)] = (float(obs), float(exc))
+            return out
+
+    def absorb_client_suspicion(self, snapshot):
+        """Fold a checkpointed ``client_suspicion_snapshot`` into this
+        hub — the restore half of the failover handoff. Merge is
+        element-wise MAX against any live accumulator: absorbing a
+        snapshot can only ever RAISE a client's recorded history, so a
+        replayed (older) snapshot cannot launder suspicion accumulated
+        since it was taken."""
+        with self._lock:
+            now = self._cohort_events
+            for cid, (obs, exc) in dict(snapshot).items():
+                ent = self._clients.get(int(cid))
+                if ent is None:
+                    self._clients[int(cid)] = [
+                        float(obs), float(exc), now
+                    ]
+                else:
+                    if self._halflife is not None and now > ent[2]:
+                        dk = self._susp_decay ** (now - ent[2])
+                        ent[0] *= dk
+                        ent[1] *= dk
+                        ent[2] = now
+                    ent[0] = max(ent[0], float(obs))
+                    ent[1] = max(ent[1], float(exc))
+
     def federated_stats(self):
         """Federated-round digest (schema v10), or None when no
         ``fed_round`` event was folded (non-federated runs)."""

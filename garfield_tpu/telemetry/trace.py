@@ -43,6 +43,10 @@ it; producers may add more):
   LEARN node: grad_compute, quorum, update, gossip
   app loop:   dispatch (tag chunk=k), eval, checkpoint
   hierarchy:  hier_wave, hier_finalize
+  federated:  ingest, fed_shard_fold, selection
+  soak:       soak_round (tag scenario=steady|rolling_restart|
+              partition|churn — one span per sustained round; the
+              SOAKBENCH SLO percentiles come from its phase stats)
 """
 
 import itertools

@@ -119,6 +119,7 @@ class AutoscaleController:
         self._margin_ok = collections.deque(maxlen=cfg.window)
         self._since_action = cfg.cooldown  # first decision needs no wait
         self.actions = 0
+        self._pre_action = None  # rescind() snapshot (see _acted)
 
     def rate(self):
         """Mean throughput over the current window (rounds / total
@@ -139,6 +140,7 @@ class AutoscaleController:
         struggled (degrades/timeouts) — retiring into that would turn a
         wobble into an outage, so scale-down requires a clean window.
         """
+        self._pre_action = None  # a rescind is only valid IMMEDIATELY
         self._round_s.append(float(round_s))
         self._margin_ok.append(quorum_margin >= 0)
         self._since_action += 1
@@ -166,12 +168,42 @@ class AutoscaleController:
 
     def _acted(self):
         self.actions += 1
+        # Snapshot the pre-action accounting so a caller that cannot
+        # actually perform the advised action (capacity, wire caps, no
+        # standby) can rescind() it — a refused action must not consume
+        # the cooldown window (the old behavior silenced the controller
+        # for a full cooldown + window refill after doing NOTHING).
+        self._pre_action = (
+            list(self._round_s), list(self._margin_ok), self._since_action
+        )
         self._since_action = 0
         # Measure the NEW membership's steady state, not the transient
         # (a spawning worker pays tens of seconds of jax boot; counting
         # those rounds would trigger a second spawn for the same cause).
         self._round_s.clear()
         self._margin_ok.clear()
+
+    def rescind(self):
+        """Undo the accounting of the action the LAST ``observe`` call
+        advised — the caller refused it (fleet at its index capacity, a
+        shard split past the wire header's 16-slot nibble, no standby
+        to merge into). Restores the measurement window, the cooldown
+        clock and the action count to their pre-advice state, so the
+        refusal is accounting-free: the controller keeps measuring the
+        UNCHANGED membership instead of a transient that never
+        happened. Returns True if there was an action to rescind;
+        becomes a no-op (False) once any later ``observe`` folds — at
+        that point the window has moved on and a partial restore would
+        splice two measurement regimes."""
+        if self._pre_action is None:
+            return False
+        round_s, margin_ok, since = self._pre_action
+        self._round_s.extend(round_s)
+        self._margin_ok.extend(margin_ok)
+        self._since_action = since
+        self.actions -= 1
+        self._pre_action = None
+        return True
 
 
 # CLI flags that configure the PS-side controller and must NOT leak into

@@ -99,6 +99,17 @@ class Checkpointer:
         steps = self._pickle_steps()
         return steps[-1] if steps else None
 
+    def steps(self):
+        """Every step present in this directory, sorted, on BOTH
+        backends — what torn-save detection across a shard group needs
+        (federated/sharding.latest_sharded_step intersects these; the
+        orbax path used to expose only ``latest_step``, which lets a
+        shard that is one save ahead hide an older step the others
+        still agree on)."""
+        if self._mgr is not None:
+            return sorted(int(s) for s in self._mgr.all_steps())
+        return self._pickle_steps()
+
     def restore(self, state_like, step=None):
         """Restore into the structure of ``state_like`` (an abstract or
         concrete TrainState from ``init_fn`` — shardings are re-applied by

@@ -43,6 +43,21 @@ byte-identical to the pre-plane format, so every committed trajectory
 and artifact pins carry over; decoders reject only unknown LOW-nibble
 dtype tags, never a nonzero plane.
 
+Round 20 (DESIGN.md §22) adds the **membership epoch** behind a second
+header version: ``encode(..., epoch=E)`` emits a 20-byte ``ver=2``
+header carrying the sender's control-plane epoch as a u32 between the
+element count and the CRC — and the CRC is SEEDED with the epoch bytes,
+so the epoch claim is under the same integrity tag as the payload (a
+relay cannot restamp a frame's epoch without producing a CRC mismatch;
+a stale epoch is provably the SENDER's stale epoch). ``epoch=None``
+(the default) emits the version-1 header unchanged — every committed
+artifact and trajectory pin predates epochs and stays byte-identical.
+Consumers on an epoch-checked plane pass ``expect_epoch=E``: a frame
+stamped with any other epoch — or carrying no epoch at all — raises the
+same attributable ``WireError`` as a cross-shard plane stamp
+(controlplane/membership.py owns what E currently is; this codec only
+enforces it).
+
 ``GARFIELD_WIRE_DTYPE=f32|bf16|int8|int4`` selects the SEND width
 (default f32) and ``GARFIELD_WIRE_TOPK=<divisor>`` (default 0 = off)
 overlays top-k sparsification on the GRADIENT plane (cluster policy:
@@ -89,23 +104,38 @@ __all__ = [
     "wire_fused",
     "topk_k",
     "check_plane",
+    "check_epoch",
     "encode",
     "decode",
     "decode_into",
     "frame_plane",
     "frame_scheme",
     "frame_elems",
+    "frame_epoch",
     "frame_nbytes",
     "HEADER_NBYTES",
+    "HEADER2_NBYTES",
     "MAX_PLANE",
+    "MAX_EPOCH",
     "QUANT_BLOCK",
     "DEFAULT_TOPK_DIV",
 ]
 
 _HDR = struct.Struct("!2sBBQI")
 HEADER_NBYTES = _HDR.size  # 16
+# Round 20: the epoch-stamped header (ver=2) — same fields plus a u32
+# membership epoch between the element count and the CRC. The epoch
+# bytes SEED the payload CRC (see module docstring), so the stamp is
+# tamper-evident, not advisory.
+_HDR2 = struct.Struct("!2sBBQII")
+HEADER2_NBYTES = _HDR2.size  # 20
+_EPOCH = struct.Struct("!I")
 _MAGIC = b"GW"
 _VERSION = 1
+_VERSION_EPOCH = 2
+# Epochs ride a u32: 4 billion membership changes outlives any
+# deployment, and a wider field would grow EVERY epoch-stamped frame.
+MAX_EPOCH = 0xFFFFFFFF
 _TAG_F32 = 0
 _TAG_BF16 = 1
 # Round 18 (DESIGN.md §20): lossy compressed payload schemes behind new
@@ -237,6 +267,24 @@ def check_plane(plane, what="plane"):
     return plane
 
 
+def check_epoch(epoch, what="epoch"):
+    """Validate a membership epoch for the v2 header's u32 field;
+    returns it as an int. Same loud-failure contract as ``check_plane``:
+    a non-integral epoch (bool, float) or one past the u32 would either
+    truncate into a DIFFERENT epoch — exactly the stale/replayed-epoch
+    confusion the stamp exists to make attributable — or overflow the
+    header, so both fail at stamp time."""
+    if isinstance(epoch, bool) or not isinstance(epoch, (int, np.integer)):
+        raise TypeError(f"{what} must be an integer, got {epoch!r}")
+    epoch = int(epoch)
+    if not 0 <= epoch <= MAX_EPOCH:
+        raise ValueError(
+            f"{what} {epoch} does not fit the wire header's u32 epoch "
+            f"field [0, {MAX_EPOCH}]"
+        )
+    return epoch
+
+
 def _quant_payload(vec, qmax, block):
     """Linear per-block quantization payload: ``[u32 block || f32
     scales || codes]`` with symmetric grid ``scale = max|x| / qmax`` per
@@ -278,7 +326,7 @@ def _dequant(codes, scales, block, elems):
 _PAIR = np.dtype([("i", "<u4"), ("v", "<f4")])
 
 
-def encode(vec, dtype=None, *, plane=0, k=None, keep_from=None,
+def encode(vec, dtype=None, *, plane=0, epoch=None, k=None, keep_from=None,
            block=QUANT_BLOCK):
     """Encode a flat float32 vector as one typed frame.
 
@@ -298,6 +346,11 @@ def encode(vec, dtype=None, *, plane=0, k=None, keep_from=None,
     plane tag — plane 0 keeps the frame byte-identical to the pre-plane
     format. Out-of-range or non-integral tags fail loudly
     (``check_plane``), never truncate.
+
+    ``epoch`` (round 20) stamps the sender's membership epoch into a
+    version-2 header, with the epoch bytes seeding the payload CRC so
+    the claim is tamper-evident; ``epoch=None`` (default) emits the
+    version-1 header byte-identical to every committed frame.
     """
     vec = np.ascontiguousarray(np.asarray(vec).reshape(-1), np.float32)
     dtype = wire_dtype() if dtype is None else dtype
@@ -364,13 +417,20 @@ def encode(vec, dtype=None, *, plane=0, k=None, keep_from=None,
         tag = _TAG_TOPK
     else:
         raise ValueError(f"unknown wire dtype {dtype!r}")
-    return _HDR.pack(
-        _MAGIC, _VERSION, tag | (plane << 4), vec.size,
-        zlib.crc32(payload),
+    if epoch is None:
+        return _HDR.pack(
+            _MAGIC, _VERSION, tag | (plane << 4), vec.size,
+            zlib.crc32(payload),
+        ) + payload
+    epoch = check_epoch(epoch)
+    return _HDR2.pack(
+        _MAGIC, _VERSION_EPOCH, tag | (plane << 4), vec.size, epoch,
+        zlib.crc32(payload, zlib.crc32(_EPOCH.pack(epoch))),
     ) + payload
 
 
-def decode(buf, *, expect_plane=None, expect_elems=None, max_elems=None):
+def decode(buf, *, expect_plane=None, expect_elems=None, max_elems=None,
+           expect_epoch=None):
     """Decode a typed frame back to a float32 vector; raises WireError.
 
     Validation order matters for the ban path: header shape first (magic,
@@ -405,9 +465,18 @@ def decode(buf, *, expect_plane=None, expect_elems=None, max_elems=None):
     Every Byzantine-facing decode site must pass one of the two: a
     sparse frame decoded with neither is an unbounded allocation the
     sender controls.
+
+    ``expect_epoch`` (round 20, DESIGN.md §22) makes the v2 header's
+    membership-epoch stamp load-bearing: a consumer serving membership
+    epoch E rejects frames stamped with any OTHER epoch — stale (a
+    pre-failover member replaying into the new membership) or ahead (a
+    forged view claim) — and rejects epoch-less version-1 frames too,
+    so a sender cannot dodge the check by omitting the stamp. The epoch
+    bytes seed the CRC, so the mismatch is attributable to the sender
+    exactly like a plane-stamp mismatch.
     """
     tag, elems, payload = _checked_frame(
-        buf, expect_plane, expect_elems, max_elems
+        buf, expect_plane, expect_elems, max_elems, expect_epoch
     )
     if tag == _TAG_BF16:
         return _bf16_to_f32(np.frombuffer(payload, np.uint16))
@@ -422,7 +491,8 @@ def decode(buf, *, expect_plane=None, expect_elems=None, max_elems=None):
     return out
 
 
-def _checked_frame(buf, expect_plane, expect_elems, max_elems):
+def _checked_frame(buf, expect_plane, expect_elems, max_elems,
+                   expect_epoch=None):
     """Shared header + structural + CRC validation of ``decode`` and
     ``decode_into``: returns ``(low-nibble tag, elems, payload)`` only
     for a frame whose bytes are provably the sender's and whose payload
@@ -438,7 +508,17 @@ def _checked_frame(buf, expect_plane, expect_elems, max_elems):
     magic, ver, tag, elems, crc = _HDR.unpack_from(buf)
     if magic != _MAGIC:
         raise WireError(f"bad magic {magic!r}")
-    if ver != _VERSION:
+    epoch = None
+    hdr_nbytes = HEADER_NBYTES
+    if ver == _VERSION_EPOCH:
+        if len(buf) < HEADER2_NBYTES:
+            raise WireError(
+                f"truncated frame: {len(buf)} bytes is shorter than the "
+                f"{HEADER2_NBYTES}-byte epoch-stamped header"
+            )
+        magic, ver, tag, elems, epoch, crc = _HDR2.unpack_from(buf)
+        hdr_nbytes = HEADER2_NBYTES
+    elif ver != _VERSION:
         raise WireError(f"unsupported wire version {ver}")
     if expect_plane is not None and (tag >> 4) != check_plane(
         expect_plane, "expect_plane"
@@ -448,6 +528,22 @@ def _checked_frame(buf, expect_plane, expect_elems, max_elems):
             f"consumer of plane/shard {int(expect_plane)} — cross-shard "
             "delivery, attributable to the sender"
         )
+    if expect_epoch is not None:
+        exp = check_epoch(expect_epoch, "expect_epoch")
+        if epoch is None:
+            raise WireError(
+                f"frame carries no membership epoch but the consumer "
+                f"serves epoch {exp} — pre-epoch (v1) frames are not "
+                "admissible on an epoch-checked plane, attributable to "
+                "the sender"
+            )
+        if epoch != exp:
+            raise WireError(
+                f"frame stamped with membership epoch {epoch} arrived at "
+                f"a consumer serving epoch {exp} — "
+                f"{'stale' if epoch < exp else 'future'}-epoch delivery, "
+                "attributable to the sender"
+            )
     tag &= 0x0F  # the high nibble is the plane tag (frame_plane)
     if tag not in _TAG_NAME:
         raise WireError(f"unknown dtype tag {tag}")
@@ -461,7 +557,7 @@ def _checked_frame(buf, expect_plane, expect_elems, max_elems):
             f"frame promises {elems} elements, past the consumer's "
             f"bound of {int(max_elems)}"
         )
-    payload = buf[HEADER_NBYTES:]
+    payload = buf[hdr_nbytes:]
     # Structural length checks come BEFORE the CRC (cheap, and a
     # truncated frame should say "truncated", not "CRC mismatch"); the
     # semantic payload checks (scale range, index ordering) come AFTER —
@@ -492,7 +588,11 @@ def _checked_frame(buf, expect_plane, expect_elems, max_elems):
                 f"sparse payload carries {len(payload) // _PAIR.itemsize} "
                 f"pairs but the header promises only {elems} elements"
             )
-    if zlib.crc32(payload) != crc:
+    # The v2 CRC is seeded with the epoch bytes (module docstring): an
+    # in-flight restamp of the epoch field fails here, so an epoch
+    # mismatch that passes the CRC is provably the sender's own stamp.
+    seed = 0 if epoch is None else zlib.crc32(_EPOCH.pack(epoch))
+    if zlib.crc32(payload, seed) != crc:
         raise WireError("payload CRC mismatch")
     return tag, int(elems), payload
 
@@ -579,7 +679,7 @@ def _checked_pairs(payload, elems):
 
 
 def decode_into(buf, out, *, expect_plane=None, expect_elems=None,
-                max_elems=None):
+                max_elems=None, expect_epoch=None):
     """Decode a typed frame DIRECTLY into a preallocated float32 row;
     returns the element count written (``out[:elems]``).
 
@@ -617,7 +717,7 @@ def decode_into(buf, out, *, expect_plane=None, expect_elems=None,
     if expect_elems is None and max_elems is None:
         max_elems = out.size
     tag, elems, payload = _checked_frame(
-        buf, expect_plane, expect_elems, max_elems
+        buf, expect_plane, expect_elems, max_elems, expect_epoch
     )
     if elems > out.size:
         raise WireError(
@@ -668,6 +768,35 @@ def frame_plane(buf):
     return tag >> 4
 
 
+def frame_epoch(buf):
+    """The membership-epoch stamp of a typed frame's header, or None
+    for a version-1 (pre-epoch) frame; raises WireError on a short
+    header, bad magic, or unknown version. Header-only like
+    ``frame_plane`` — the stamp is unvalidated against any view until
+    ``decode``/``decode_into`` pins it with ``expect_epoch`` (which
+    also proves it under the CRC), so this is strictly a labelling
+    read: a directory deciding whether to even attempt a decode, a
+    byte-accounting consumer tagging rejects per epoch."""
+    if len(buf) < HEADER_NBYTES:
+        raise WireError(
+            f"truncated frame: {len(buf)} bytes is shorter than the "
+            f"{HEADER_NBYTES}-byte header"
+        )
+    magic, ver, _, _, _ = _HDR.unpack_from(buf)
+    if magic != _MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if ver == _VERSION:
+        return None
+    if ver != _VERSION_EPOCH:
+        raise WireError(f"unsupported wire version {ver}")
+    if len(buf) < HEADER2_NBYTES:
+        raise WireError(
+            f"truncated frame: {len(buf)} bytes is shorter than the "
+            f"{HEADER2_NBYTES}-byte epoch-stamped header"
+        )
+    return int(_HDR2.unpack_from(buf)[4])
+
+
 def frame_scheme(buf):
     """The payload scheme name of a typed frame's header ("f32", "bf16",
     "int8", "int4", "topk"); raises WireError on a short header, bad
@@ -708,23 +837,26 @@ def frame_elems(buf):
     return int(elems)
 
 
-def frame_nbytes(elems, dtype=None, *, k=None, block=QUANT_BLOCK):
+def frame_nbytes(elems, dtype=None, *, k=None, block=QUANT_BLOCK,
+                 epoch=False):
     """Total wire bytes of an ``elems``-element frame at ``dtype`` —
     the bench/telemetry accounting twin of ``encode``. For ``"topk"``,
     ``k`` is the kept-pair count (default: the GARFIELD_WIRE_TOPK
-    divisor's ``topk_k``, falling back to DEFAULT_TOPK_DIV)."""
+    divisor's ``topk_k``, falling back to DEFAULT_TOPK_DIV).
+    ``epoch=True`` accounts the v2 epoch-stamped header (+4 bytes)."""
     dtype = wire_dtype() if dtype is None else dtype
     elems = int(elems)
+    hdr = HEADER2_NBYTES if epoch else HEADER_NBYTES
     if dtype in ("f32", "bf16"):
-        return HEADER_NBYTES + elems * (2 if dtype == "bf16" else 4)
+        return hdr + elems * (2 if dtype == "bf16" else 4)
     if dtype in ("int8", "int4"):
         nblocks = -(-elems // int(block)) if elems else 0
         codes = elems if dtype == "int8" else (elems + 1) // 2
-        return HEADER_NBYTES + 4 + nblocks * 4 + codes
+        return hdr + 4 + nblocks * 4 + codes
     if dtype == "topk":
         if k is None:
             k = topk_k(elems, wire_topk() or DEFAULT_TOPK_DIV)
-        return HEADER_NBYTES + int(k) * _PAIR.itemsize
+        return hdr + int(k) * _PAIR.itemsize
     raise ValueError(f"unknown wire dtype {dtype!r}")
 
 

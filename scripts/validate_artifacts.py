@@ -30,7 +30,12 @@ cell/matched_accuracy/headroom columns; auto-globbed like every
 ``*_r*.jsonl``) — and the v12 selection-kernel additions (FEDBENCH_r02's
 ``fed_bench`` scaling rows with their per-phase ``phases`` p50/p95
 attribution — ingest/h2d/fold/selection — and SELBENCH-style
-``gar_bench`` rows with grid/impl/wave_buckets/per_bucket_s columns).
+``gar_bench`` rows with grid/impl/wave_buckets/per_bucket_s columns) —
+and the v13 control-plane additions (the ``soak_bench`` kind behind
+SOAKBENCH_r*'s steady / rolling_restart / partition / churn rows with
+their p50/p95/p99 SLO columns and the measured ``kill_cost_rounds``,
+plus the ``membership`` event — one epoch bump per failover / split /
+merge; both auto-globbed like every ``*_r*.jsonl``).
 
   python scripts/validate_artifacts.py            # repo root auto-found
   python scripts/validate_artifacts.py /some/repo

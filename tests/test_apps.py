@@ -131,6 +131,10 @@ def test_garfield_cc_guanyu_layer_granularity():
     assert int(state.step) == 3 and summary["final_loss"] is not None
 
 
+# Two full app runs + a resume — the single heaviest test in the suite;
+# off the tier-1 fast shard for wall-time budget. Resume semantics stay
+# tier-1-covered by test_federated's TestFailoverDeterminism.
+@pytest.mark.slow
 def test_checkpoint_resume(tmp_path):
     ckpt_args = FAST + [
         "--num_workers", "8", "--gar", "average",

@@ -152,3 +152,37 @@ class TestWorkerCommand:
             1, argv=[], main_module="garfield_tpu.apps.learn"
         )
         assert cmd[2] == "garfield_tpu.apps.learn"
+
+
+class TestRescind:
+    """A refused action (capacity, wire caps, no standby) must be
+    accounting-free: rescind() restores the measurement window, the
+    cooldown clock and the action count — but only IMMEDIATELY after
+    the advising observe, before the window moves on."""
+
+    def test_rescind_restores_window_cooldown_and_count(self):
+        ctl = autoscale.AutoscaleController(_cfg())
+        # Slow rounds: the 4th observe fills the window and advises
+        # a spawn (one more observe would expire the rescind snapshot).
+        assert _feed(ctl, 1.0, 4, active=4) == [1]
+        assert ctl.actions == 1 and ctl._since_action == 0
+        assert ctl.rescind() is True
+        assert ctl.actions == 0
+        assert ctl.rate() is not None  # window NOT cleared by a refusal
+        # The controller keeps advising on the unchanged membership:
+        # the very next observe can act again (no consumed cooldown).
+        assert ctl.observe(1.0, active=4, quorum_margin=0) == 1
+
+    def test_rescind_without_action_is_noop(self):
+        ctl = autoscale.AutoscaleController(_cfg())
+        assert ctl.rescind() is False
+        _feed(ctl, 1.0, 3, active=4)  # window not yet full: no action
+        assert ctl.rescind() is False
+        assert ctl.actions == 0
+
+    def test_rescind_expires_after_any_later_observe(self):
+        ctl = autoscale.AutoscaleController(_cfg())
+        assert _feed(ctl, 1.0, 4, active=4) == [1]
+        ctl.observe(1.0, active=5, quorum_margin=0)  # window moved on
+        assert ctl.rescind() is False
+        assert ctl.actions == 1  # the unrescinded action stands

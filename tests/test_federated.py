@@ -607,3 +607,134 @@ class TestTelemetryV12:
                     "gar_bench", gar="krum", n=16, f=6, d=256,
                     latency_s=1e-5, **bad,
                 ))
+
+
+# ---------------------------------------------------------------------------
+# control plane: checkpointed failover / resume (DESIGN.md §22)
+
+
+import json  # noqa: E402
+import os  # noqa: E402
+
+from garfield_tpu import controlplane as cp  # noqa: E402
+
+
+class TestFailoverDeterminism:
+    """The handoff contract, pinned at the trajectory level: a shard
+    killed mid-round and promoted from its span checkpoint re-runs the
+    interrupted round and lands on the SAME model bytes as a run that
+    never died."""
+
+    N, D, S = 16, 96, 2
+
+    def _engine(self, tmp_path, sub):
+        sampler = fed.CohortSampler(self.N, self.N, seed=11,
+                                    byz_frac=0.05)
+        model0 = np.random.default_rng(5).normal(
+            size=self.D).astype(np.float32)
+        return fed.FedRoundEngine(
+            model0, self.S, sampler, lr=0.05, epoch=1,
+            checkpoint_dir=str(tmp_path / sub),
+        )
+
+    def _rows(self, r):
+        return np.random.default_rng([21, r]).normal(
+            size=(self.N, self.D)).astype(np.float32)
+
+    def test_kill_and_rerun_is_bitwise(self, tmp_path):
+        ref = self._engine(tmp_path, "ref")
+        for r in range(4):
+            ref.begin_round()
+            ref.ingest_rows(self._rows(r))
+            ref.finish_round()
+
+        eng = self._engine(tmp_path, "victim")
+        for r in range(4):
+            active, f = eng.begin_round()
+            rows = self._rows(r)
+            if r == 2:
+                # The shard dies with half the cohort folded in. The
+                # standby restores the round-1 span checkpoint and pins
+                # itself to re-run round 2 — mid-round fold state is
+                # deliberately NOT checkpointed (arrival order is
+                # bucket assignment; a resumed half-fold would not be
+                # the bytes a clean round produces).
+                eng.ingest_rows(rows[: self.N // 2])
+                srv, rerun = cp.promote_standby(eng, 1)
+                assert rerun == 2 and eng.epoch == 2
+                active, f = eng.begin_round()  # re-arm ALL shards
+            eng.ingest_rows(rows)
+            eng.finish_round()
+
+        assert np.array_equal(eng.model, ref.model)  # bitwise
+        # The failover bumped the epoch; the clean run never did.
+        assert eng.epoch == 2 and ref.epoch == 1
+
+    def test_resume_restores_bitwise_round_and_epoch(self, tmp_path):
+        eng = self._engine(tmp_path, "a")
+        eng.resize(1)  # one epoch bump (1 -> 2) recorded in control
+        for r in range(3):
+            eng.begin_round()
+            eng.ingest_rows(self._rows(r))
+            eng.finish_round()
+        want = eng.model.copy()
+
+        fresh = self._engine(tmp_path, "b")
+        fresh.resize(1)
+        with pytest.raises(FileNotFoundError, match="complete"):
+            fresh.resume()  # its own dir is empty
+        fresh._ckpt_dir = eng._ckpt_dir
+        step = fresh.resume()
+        assert step == 2 and fresh.round == 3
+        assert np.array_equal(fresh.model, want)
+        assert fresh.epoch == eng.epoch == 2
+        # The resumed engine serves round 3 and stays on trajectory.
+        fresh.begin_round()
+        eng.begin_round()
+        fresh.ingest_rows(self._rows(3))
+        eng.ingest_rows(self._rows(3))
+        fresh.finish_round()
+        eng.finish_round()
+        assert np.array_equal(fresh.model, eng.model)
+
+    def test_restored_shard_refuses_unknown_round(self, tmp_path):
+        """Satellite: after restore, the engine can only serve the
+        round after its checkpoint — any other round is a LOUD refusal,
+        not a silent fold against a stale span."""
+        eng = self._engine(tmp_path, "a")
+        for r in range(2):
+            eng.begin_round()
+            eng.ingest_rows(self._rows(r))
+            eng.finish_round()
+        eng2 = self._engine(tmp_path, "a")
+        eng2.resume()
+        eng2.round = 5  # a driver resuming at the wrong round
+        with pytest.raises(RuntimeError, match="refusing loudly"):
+            eng2.begin_round()
+        with pytest.raises(RuntimeError, match="no span checkpoint"):
+            eng2.shards[0].begin_round(0, self.N, 1)
+        eng2.round = 2  # the one round the restored spans are valid for
+        eng2.begin_round()
+
+    def test_torn_checkpoint_never_restores_mixed_rounds(self, tmp_path):
+        eng = self._engine(tmp_path, "a")
+        for r in range(3):
+            eng.begin_round()
+            eng.ingest_rows(self._rows(r))
+            eng.finish_round()
+        # Tear step 2: the control record vanished (crash between the
+        # span save and the control save).
+        os.remove(os.path.join(eng._ckpt_dir, "control", "ctl_2.json"))
+        eng2 = self._engine(tmp_path, "a")
+        assert eng2.resume() == 1  # falls back to the newest COMPLETE
+        with pytest.raises(FileNotFoundError, match="complete"):
+            eng2.resume(step=2)
+        # A control record disagreeing with its step key is torn too.
+        path = os.path.join(eng._ckpt_dir, "control", "ctl_1.json")
+        with open(path) as fp:
+            rec = json.load(fp)
+        rec["round"] = 7
+        with open(path, "w") as fp:
+            json.dump(rec, fp)
+        with pytest.raises(ValueError, match="torn"):
+            self._engine(tmp_path, "a").resume(step=1)

@@ -893,3 +893,100 @@ class TestDecodeInto:
         assert wire.wire_fused() is False
         monkeypatch.setenv("GARFIELD_WIRE_FUSED_DECODE", "on")
         assert wire.wire_fused() is True
+
+
+class TestEpochStamp:
+    """The v2 epoch-stamped header (round 20, DESIGN.md §22): the
+    membership epoch rides every frame under an epoch-seeded CRC, so a
+    consumer pinned to its directory's epoch rejects stale, future,
+    pre-epoch (v1) and restamped frames as attributable ban evidence."""
+
+    SCHEMES = ["f32", "bf16", "int8", "int4", "topk"]
+
+    def _vec(self, n=257, seed=0):
+        return np.random.default_rng(seed).normal(
+            size=n).astype(np.float32)
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    def test_roundtrip_every_scheme(self, scheme):
+        vec = self._vec()
+        frame = wire.encode(vec, dtype=scheme, plane=2, epoch=7)
+        # +4 header bytes vs the v1 frame of the same payload.
+        assert len(frame) == len(
+            wire.encode(vec, dtype=scheme, plane=2)) + 4
+        assert len(frame) == wire.frame_nbytes(
+            vec.size, scheme, epoch=True)
+        assert wire.frame_epoch(frame) == 7
+        assert wire.frame_plane(frame) == 2
+        want = wire.decode(wire.encode(vec, dtype=scheme))
+        out = wire.decode(frame, expect_plane=2, expect_epoch=7)
+        np.testing.assert_array_equal(out, want)
+        # decode_into sees the same stamp
+        tgt = np.zeros(vec.size, np.float32)
+        assert wire.decode_into(
+            frame, tgt, expect_plane=2, expect_epoch=7) == vec.size
+        np.testing.assert_array_equal(tgt, want)
+
+    def test_v1_frames_carry_no_epoch(self):
+        frame = wire.encode(self._vec(), "f32")
+        assert wire.frame_epoch(frame) is None
+        wire.decode(frame)  # unpinned consumers accept v1 unchanged
+
+    def test_stale_future_and_epochless_rejected(self):
+        vec = self._vec()
+        stale = wire.encode(vec, "int8", epoch=6)
+        with pytest.raises(wire.WireError, match="stale-epoch"):
+            wire.decode(stale, expect_epoch=7)
+        future = wire.encode(vec, "int8", epoch=8)
+        with pytest.raises(wire.WireError, match="future-epoch"):
+            wire.decode(future, expect_epoch=7)
+        v1 = wire.encode(vec, "int8")
+        with pytest.raises(wire.WireError, match="no membership epoch"):
+            wire.decode(v1, expect_epoch=7)
+        # Accepted exactly at the pin.
+        np.testing.assert_array_equal(
+            wire.decode(stale, expect_epoch=6), wire.decode(v1))
+
+    def test_epoch_restamp_is_crc_mismatch(self):
+        """A relay rewriting the header's epoch bytes to match the
+        consumer's pin still fails: the CRC is seeded with the epoch,
+        so the restamped frame is a codec failure, not a valid frame
+        from a newer epoch."""
+        frame = bytearray(wire.encode(self._vec(), "f32", epoch=6))
+        off = wire._HDR2.size - 8  # epoch u32 sits before the crc u32
+        assert int.from_bytes(frame[off:off + 4], "big") == 6
+        frame[off:off + 4] = (7).to_bytes(4, "big")
+        with pytest.raises(wire.WireError, match="CRC"):
+            wire.decode(bytes(frame), expect_epoch=7)
+        sentinel = np.full(257, np.float32(-1.5))
+        out = sentinel.copy()
+        with pytest.raises(wire.WireError):
+            wire.decode_into(bytes(frame), out, expect_epoch=7)
+        np.testing.assert_array_equal(out, sentinel)
+
+    def test_check_epoch_validation(self):
+        assert wire.check_epoch(0) == 0
+        assert wire.check_epoch(wire.MAX_EPOCH) == wire.MAX_EPOCH
+        for bad in (-1, wire.MAX_EPOCH + 1):
+            with pytest.raises(ValueError):
+                wire.check_epoch(bad)
+        for bad in (True, 1.5, "7", None):
+            with pytest.raises(TypeError):
+                wire.check_epoch(bad)
+        with pytest.raises(ValueError):
+            wire.encode(self._vec(8), "f32", epoch=wire.MAX_EPOCH + 1)
+
+    def test_frame_epoch_header_only_rejects(self):
+        frame = wire.encode(self._vec(), "f32", epoch=3)
+        with pytest.raises(wire.WireError):
+            wire.frame_epoch(frame[:10])
+        with pytest.raises(wire.WireError):
+            wire.frame_epoch(frame[:18])  # v2 header cut short
+        bad = bytearray(frame)
+        bad[0] = 0x00
+        with pytest.raises(wire.WireError):
+            wire.frame_epoch(bytes(bad))
+        bad = bytearray(frame)
+        bad[2] = 0x09  # unknown version byte
+        with pytest.raises(wire.WireError):
+            wire.frame_epoch(bytes(bad))
