@@ -108,46 +108,119 @@ class HeadSpec:
     classes: int
 
 
-def _leaf_list(params):
+def _key_str(k):
+    # jax path entries are DictKey/GetAttrKey/SequenceKey wrappers; pull
+    # the underlying name out so flax param dicts yield plain strings.
+    for attr in ("key", "name", "idx"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def _named_leaves(tree):
     import jax
     import jax.numpy as jnp
 
-    leaves = jax.tree.leaves(params)
-    spans, start = [], 0
-    for leaf in leaves:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out, start = [], 0
+    for path, leaf in flat:
         size = int(np.prod(jnp.shape(leaf))) if jnp.ndim(leaf) else 1
-        spans.append((start, start + size))
+        out.append((
+            tuple(_key_str(k) for k in path), leaf, (start, start + size)
+        ))
         start += size
-    return leaves, spans
+    return out
+
+
+def _head_index(named, kernel_ndim):
+    """Flatten-order index of the classifier-head kernel, or None.
+
+    Resolution hierarchy (the transformer family broke the old "last
+    2-D leaf" rule — flax flattens by SORTED string key, so ViT's
+    ``pos_embedding`` param (lowercase sorts after every capitalized
+    module scope) and GPT's nested ``EncoderBlock_*`` MLP kernels all
+    flatten AFTER the top-level ``Dense_0`` head):
+
+      1. the highest-numbered TOP-LEVEL ``Dense_i/kernel`` — flax's
+         auto-naming for the final projection of every zoo model that
+         has one (CNNs and transformers alike);
+      2. a model with an ``nn.Embed`` table (final path key
+         ``embedding``) but NO top-level Dense head ties its output
+         head to the embedding (``Embed.attend``) — there is no head
+         gradient distinct from the embedding gradient to fingerprint,
+         so this REFUSES loudly rather than silently fingerprinting
+         some interior matrix;
+      3. the last ``kernel``-named leaf of head rank (nested heads in
+         hand-rolled scopes);
+      4. the last leaf of head rank (non-flax trees with no string
+         naming — the legacy rule, still exercised by raw-dict tests).
+    """
+    top_dense, top_i = None, -1
+    last_kernel = None
+    last_nd = None
+    has_embed = False
+    for i, (path, leaf, _span) in enumerate(named):
+        nd = int(np.ndim(leaf)) if not hasattr(leaf, "ndim") else int(
+            leaf.ndim
+        )
+        if path and path[-1] == "embedding":
+            has_embed = True
+        if nd != kernel_ndim:
+            continue
+        last_nd = i
+        if not path or path[-1] != "kernel":
+            continue
+        last_kernel = i
+        if len(path) == 2 and path[0].startswith("Dense_"):
+            try:
+                di = int(path[0].rsplit("_", 1)[1])
+            except ValueError:
+                continue
+            if di > top_i:
+                top_i, top_dense = di, i
+    if top_dense is not None:
+        return top_dense
+    if has_embed:
+        raise ValueError(
+            "data-plane defense cannot fingerprint an embedding-tied "
+            "head: the params carry an nn.Embed table but no top-level "
+            "Dense head (GPT(tied=True) layout) — the output head IS "
+            "the embedding gradient, which every token in the batch "
+            "touches, so no per-class head block exists. Use an untied "
+            "head (tied=False) to run the data-plane defense."
+        )
+    if last_kernel is not None:
+        return last_kernel
+    return last_nd
 
 
 def head_spec(params):
     """``HeadSpec`` of a params tree, or None when no head is found.
 
-    The classifier head is the LAST 2-D leaf in ravel order (flax
-    flattens module dicts by sorted key, so the final Dense kernel is
-    the last matrix); its trailing dim is the class count. The bias is
-    the immediately preceding leaf when that is a matching
-    (classes,)-vector (flax sorts ``bias`` before ``kernel`` inside one
-    Dense scope). Models without a 2-D leaf (none in the zoo) get None
-    and the data-plane defense refuses loudly at the caller.
+    The classifier head is located by ``_head_index`` (top-level
+    ``Dense_{max}`` kernel first; embedding-tied layouts REFUSE with a
+    ValueError; legacy last-matrix fallbacks for hand-rolled trees);
+    its trailing dim is the class count. The bias is the immediately
+    preceding leaf when that is a matching (classes,)-vector (flax
+    sorts ``bias`` before ``kernel`` inside one Dense scope). Models
+    without any matrix leaf get None and the data-plane defense
+    refuses loudly at the caller.
     """
     import jax.numpy as jnp
 
-    leaves, spans = _leaf_list(params)
-    k_idx = None
-    for idx, leaf in enumerate(leaves):
-        if jnp.ndim(leaf) == 2:
-            k_idx = idx
+    named = _named_leaves(params)
+    k_idx = _head_index(named, 2)
     if k_idx is None:
         return None
-    feat, classes = (int(s) for s in jnp.shape(leaves[k_idx]))
+    leaf = named[k_idx][1]
+    feat, classes = (int(s) for s in jnp.shape(leaf))
     bias = None
-    if k_idx > 0 and jnp.ndim(leaves[k_idx - 1]) == 1 \
-            and int(jnp.shape(leaves[k_idx - 1])[0]) == classes:
-        bias = spans[k_idx - 1]
+    if k_idx > 0:
+        prev = named[k_idx - 1][1]
+        if jnp.ndim(prev) == 1 and int(jnp.shape(prev)[0]) == classes:
+            bias = named[k_idx - 1][2]
     return HeadSpec(
-        kernel=spans[k_idx], bias=bias, feat=feat, classes=classes
+        kernel=named[k_idx][2], bias=bias, feat=feat, classes=classes
     )
 
 
@@ -156,25 +229,23 @@ def head_leaves(stacked_tree):
     STACKED gradient tree (leading rank axis per leaf) — the in-graph
     twin of ``head_spec`` + ``head_from_rows``, selected statically at
     trace time so nothing head-shaped exists in the program when the
-    defense is off. The head kernel is the last 3-D leaf (rank axis +
-    the (feat, classes) matrix); rows are transposed to class-major.
+    defense is off. The head kernel is resolved by the SAME hierarchy
+    as ``head_spec`` (one rank higher: rank axis + the (feat, classes)
+    matrix); rows are transposed to class-major.
     """
-    import jax
     import jax.numpy as jnp
 
-    leaves = jax.tree.leaves(stacked_tree)
-    k_idx = None
-    for idx, leaf in enumerate(leaves):
-        if leaf.ndim == 3:
-            k_idx = idx
+    named = _named_leaves(stacked_tree)
+    k_idx = _head_index(named, 3)
     if k_idx is None:
         return None, None
-    kernel = jnp.swapaxes(leaves[k_idx], 1, 2)  # (n, classes, feat)
+    kernel = jnp.swapaxes(named[k_idx][1], 1, 2)  # (n, classes, feat)
     classes = kernel.shape[1]
     bias = None
-    if k_idx > 0 and leaves[k_idx - 1].ndim == 2 \
-            and leaves[k_idx - 1].shape[1] == classes:
-        bias = leaves[k_idx - 1]
+    if k_idx > 0:
+        prev = named[k_idx - 1][1]
+        if prev.ndim == 2 and prev.shape[1] == classes:
+            bias = prev
     return kernel, bias
 
 
@@ -268,20 +339,34 @@ def spectral_scores(fp, iters=POWER_ITERS):
 
 def suspect_class(kernel, bias=None):
     """Index of the class the data-plane evidence points at: the class
-    whose bias z-scores (or, bias-less, crowd-normalized row norms)
+    whose bias statistics (or, bias-less, crowd-normalized row norms)
     disperse the most across ranks — a relabeling cohort concentrates
     its departure on the TARGET class's statistics. Traced-argmax safe.
+
+    Dispersion is measured ROBUSTLY (|x - median| / MAD), not by
+    mean/std z-scores: a cohort of f coherent outliers corrupts the
+    mean and inflates the std of its OWN class, capping the classic
+    z at ~sqrt((n-f)/f) — at f/n = 1/4 that is 1.73, and a single
+    noisy rank in a quiet class beats it, steering the 2-means at the
+    wrong rows (the token-backdoor cell that exposed this: 8 workers,
+    f=2, target-class bias gradient -0.9 vs honest 0.05, and the old
+    statistic picked a clean class). Median/MAD stay anchored to the
+    honest crowd for any cohort below n/2, so the target class's z is
+    unbounded in the departure size. Per-class MADs are floored by a
+    fraction of their crowd median so a near-constant class cannot win
+    on numerical noise.
     """
     xp = _xp(kernel)
     if bias is not None:
-        b = bias.astype(xp.float32)
-        z = xp.abs(b - xp.mean(b, axis=0, keepdims=True)) / (
-            xp.std(b, axis=0, keepdims=True) + _EPS
-        )
+        stat = bias.astype(xp.float32)
     else:
         H = kernel.astype(xp.float32)
-        r = xp.sqrt(xp.sum(H * H, axis=-1) + _EPS)
-        z = xp.abs(r / (xp.mean(r, axis=0, keepdims=True) + _EPS) - 1.0)
+        stat = xp.sqrt(xp.sum(H * H, axis=-1) + _EPS)
+    med = xp.median(stat, axis=0, keepdims=True)
+    dev = xp.abs(stat - med)
+    mad = xp.median(dev, axis=0, keepdims=True)
+    floor = 0.01 * xp.mean(mad) + _EPS
+    z = dev / (mad + floor)
     return xp.argmax(xp.max(z, axis=0))
 
 

@@ -268,7 +268,14 @@ def _selection_main(args):
 
     rules = args.gars or list(SELECTION_RULES)
     sizes = args.sel_buckets or [8, 16, 32]
-    ds = args.ds or [256]
+    # Default d sweep: the legacy 256 anchor plus the attention-shaped
+    # regimes (d = heads * d_head * seq — a transformer worker's
+    # per-layer activation-gradient granularity): 768 = the gpt_tiny
+    # block (48-dim x 16-token copytask window), 3072 = the vit_tiny
+    # block (3 heads x 16 d_head x 64 patches). Selection cost is
+    # d-linear only through the Gram build, so these rows pin where the
+    # transformer family's buckets actually land.
+    ds = args.ds or [256, 768, 3072]
     wave = args.hier_wave
     key = jax.random.PRNGKey(0)
     results = []

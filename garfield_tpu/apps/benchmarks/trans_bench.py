@@ -1,0 +1,345 @@
+"""Slot-fused transformer A/B + token-backdoor robustness capture.
+
+TRANSBENCH_r*'s capture tool (schema v14 ``trans_bench`` rows). Two
+modes share the gar_bench timing discipline (dependency-chained reps,
+softsign DCE guard, adaptive rep sizing, min over ``--trials``
+independent paired-reps measurements — VERDICT r4 #3):
+
+  - **A/B (default)**: per-slot gradient time of the slot-fused twin
+    (``models/slotfused.build_slot_grad_fn`` — ONE forward/backward
+    over the flat (slots*b) batch) vs the unrolled per-slot reference
+    (a python loop of per-worker grads inside one jit — exactly what
+    ``parallel.core.per_slot_grads`` dispatches without a twin), on
+    the transformer families (vit_tiny / gpt_tiny). The chain folds a
+    softsign-guarded mean-gradient step back into the params, so every
+    gradient coordinate is a real data dependency of the next
+    iteration and XLA cannot shed the backward pass.
+  - **--robust**: trained token-backdoor cells on gpt_tiny/copytask —
+    the cohort stamps a fixed token PREFIX (``attacks/targeted.py``
+    integer branch) and relabels to the target; ASR is measured by
+    ``parallel.targeted_eval`` with the v9 attribution discipline
+    (``asr_baseline`` — report attributable lift, not raw rate), once
+    undefended and once with the data-plane head-gradient
+    fingerprints (``defense={'weighted': False, 'data': {}}`` — the
+    reworked ``head_spec`` locating the untied Dense head).
+
+  python -m garfield_tpu.apps.benchmarks.trans_bench \\
+      --models vit_tiny gpt_tiny --slots 8 --json TRANSBENCH_r01.json
+  python -m garfield_tpu.apps.benchmarks.trans_bench --robust \\
+      --steps 150 --json TRANSBENCH_r01.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils import profiling
+from ..common import peak_rss_bytes
+
+# Model geometry per A/B cell: (input maker, seq length, heads, depth).
+# Inputs stay CPU-tractable (the committed r01 rows are a CPU capture —
+# chip recapture pending, BASELINE.md discipline) but keep the real
+# attention shapes: vit_tiny at 16x16 runs 16 patches of width 48,
+# gpt_tiny the full 16-token copytask window.
+AB_MODELS = ("vit_tiny", "gpt_tiny")
+
+
+def _make_inputs(name, slots, batch, img, key):
+    if name == "vit_tiny":
+        x = jax.random.normal(
+            key, (slots, batch, img, img, 3), jnp.float32
+        )
+        seq = (img // 4) ** 2
+    else:
+        from ...data import COPYTASK_SEQ, COPYTASK_VOCAB
+
+        x = jax.random.randint(
+            key, (slots, batch, COPYTASK_SEQ), 0, COPYTASK_VOCAB
+        )
+        seq = COPYTASK_SEQ
+    y = jax.random.randint(
+        jax.random.fold_in(key, 1), (slots, batch), 0, 10
+    )
+    return x, y, seq
+
+
+def _bench_pair(chains, params_host, reps, trials):
+    """gar_bench.bench_one's timing loop over params-tree chains, with
+    the A/B trials INTERLEAVED: each trial times every path back to
+    back, so slow machine drift (shared-host CPU reality — observed
+    2x swings across minutes on otherwise-idle captures) cancels out
+    of the fused/unrolled ratio instead of landing on whichever path
+    was timed last. ``params_host`` is a HOST (numpy) tree: the chains
+    donate their input, so every warmup/timed run starts from a fresh
+    upload. Returns ({path: min latency}, {path: reps})."""
+    timed, reps_used = {}, {}
+    for path, chain in chains.items():
+        # compile + warm + sync (the uploaded tree is donated)
+        p0 = jax.tree.map(
+            np.array, chain(jax.tree.map(jnp.array, params_host))
+        )
+
+        def make_timed(chain=chain, p0=p0):
+            def timed_k(k):
+                p = jax.tree.map(jnp.array, p0)
+                np.asarray(jax.tree.leaves(p)[0].ravel()[:1])  # drain H2D
+                t0 = time.perf_counter()
+                for _ in range(k):
+                    p = chain(p)
+                np.asarray(jax.tree.leaves(p)[0].ravel()[:1])  # sync
+                return time.perf_counter() - t0
+
+            return timed_k
+
+        timed[path] = make_timed()
+        r = reps
+        est = profiling.paired_reps(timed[path], reps, pairs=2)
+        if est is not None and est * r < 0.25:
+            r = min(4000, max(reps, int(0.5 / max(est, 1e-7))))
+        reps_used[path] = r
+    vals = {path: [] for path in chains}
+    for _ in range(max(1, trials)):
+        for path in chains:
+            v = profiling.paired_reps(
+                timed[path], reps_used[path], pairs=4, agg="min"
+            )
+            if v is not None:
+                vals[path].append(v)
+    return (
+        {p: (min(v) if v else None) for p, v in vals.items()},
+        reps_used,
+    )
+
+
+def ab_cell(name, *, slots, batch, img, reps, trials, seed=0):
+    """Both paths of one model: {'fused': latency, 'unrolled': latency,
+    'd': params, 'seq'/'heads'/'depth'} — latency is per CHAIN STEP
+    (all ``slots`` per-worker gradients); divide by slots for the
+    per-slot number."""
+    from ...models import select_model, slotfused
+    from ...parallel import core
+    from ...utils import selectors
+
+    dataset = "copytask" if name == "gpt_tiny" else "cifar10"
+    module = select_model(name, dataset)
+    # Softmax cross-entropy, NOT nll: the transformer zoo heads emit raw
+    # logits, and nll-on-logits is LINEAR in the logits — the backward
+    # pass would skip the softmax entirely and the A/B latency would not
+    # represent a real fine-tuning gradient.
+    loss = selectors.select_loss("crossentropy")
+    key = jax.random.PRNGKey(seed)
+    x, y, seq = _make_inputs(name, slots, batch, img, key)
+    init_fn, grad_fn, _ = core.make_worker_fns(module, loss)
+    params, ms = init_fn(jax.random.PRNGKey(0), x[0])
+    params_host = jax.tree.map(np.array, params)
+    keys = jax.random.split(jax.random.PRNGKey(2), slots)
+    d = int(sum(
+        int(np.prod(l.shape)) for l in jax.tree.leaves(params)
+    ))
+
+    fused = slotfused.build_slot_grad_fn(module, loss)
+    if fused is None:
+        raise RuntimeError(f"{name}: no slot-fused twin registered")
+
+    def unrolled(p, ms_, x_, y_, k_):
+        outs = [
+            grad_fn(p, ms_, x_[i], y_[i], k_[i]) for i in range(slots)
+        ]
+        g = jax.tree.map(lambda *a: jnp.stack(a), *[o[0] for o in outs])
+        return g, (None, ms_)
+
+    def make_chain(fn):
+        def _chain(p):
+            g_st, _ = fn(p, ms, x, y, keys)
+
+            def upd(pl, gl):
+                gm = gl.mean(axis=0).astype(pl.dtype)
+                # softsign DCE guard (the r5 microbench-trap rule):
+                # every gradient coordinate feeds the next iteration
+                # through a nonlinearity XLA cannot rewrite away, and
+                # the bounded update keeps the chained params finite.
+                return pl - 0.01 * gm * jax.lax.rsqrt(1.0 + gm * gm)
+
+            return jax.tree.map(upd, p, g_st)
+
+        return jax.jit(_chain, donate_argnums=0)
+
+    cell = {"d": d, "seq": seq, "heads": int(module.heads),
+            "depth": int(module.depth)}
+    latencies, used = _bench_pair(
+        {"fused": make_chain(fused), "unrolled": make_chain(unrolled)},
+        params_host, reps, trials,
+    )
+    for path in ("fused", "unrolled"):
+        cell[path] = latencies[path]
+        cell[f"{path}_reps"] = used[path]
+    return cell
+
+
+def robust_cells(*, steps, num_workers, f, seed=0):
+    """Token-backdoor ASR cells on gpt_tiny/copytask: defense off vs
+    the data-plane head-gradient fingerprints, same seed, same cohort.
+    Honest numbers either way — the artifact records what the defense
+    actually buys on this cell, with the clean-model ``asr_baseline``
+    attribution (schema v9 discipline)."""
+    from ... import data as data_lib
+    from ... import parallel
+    from ...attacks import targeted as targeted_lib
+    from ...models import select_model
+    from ...parallel import aggregathor
+    from ...utils import selectors
+
+    module = select_model("gpt_tiny", "copytask")
+    loss = selectors.select_loss("crossentropy")
+    m = data_lib.DatasetManager("copytask", 32, num_workers, num_workers, 0)
+    m.num_ps = 0
+    xs, ys = m.sharded_train_batches()
+    test = parallel.EvalSet(m.get_test_set())
+    params = {
+        "source": 0, "target": 3, "poison_frac": 1.0,
+        # An out-of-vocab-for-distractors prefix: token 30 appears in
+        # no clean copytask sequence (distractors live in [10, 30)).
+        "trigger_token": 30, "trigger_size": 2,
+    }
+    cfg = targeted_lib.configure("backdoor", params, num_classes=10)
+    rows = []
+    for defname, defense in (
+        ("none", None),
+        ("data", {"weighted": False, "data": {}}),
+    ):
+        # Adam, not hot SGD: plain SGD needs a rate that NaNs this
+        # transformer within 150 steps before it learns the task; adam
+        # at 2e-3 reaches ~0.998 clean accuracy in 150 rounds.
+        opt = selectors.select_optimizer("adam", lr=2e-3)
+        init_fn, step_fn, eval_fn = aggregathor.make_trainer(
+            module, loss, opt, "average", num_workers=num_workers,
+            f=f, attack="backdoor", attack_params=params,
+            defense=defense,
+        )
+        state = init_fn(jax.random.PRNGKey(seed), xs[0, 0])
+        nb = xs.shape[1]
+        for i in range(steps):
+            b = i % nb
+            state, metrics = step_fn(
+                state, jnp.asarray(xs[:, b]), jnp.asarray(ys[:, b])
+            )
+        rep = parallel.targeted_eval(
+            state, eval_fn, test, source=0, target=3, trigger_cfg=cfg,
+        )
+        rows.append({
+            "check": "backdoor/gpt_tiny", "model": "gpt_tiny",
+            "cell": f"backdoor/{defname}", "defense": defname,
+            "slots": num_workers, "d": int(sum(
+                int(np.prod(l.shape))
+                for l in jax.tree.leaves(state.params)
+            )),
+            "seq": int(xs.shape[-1]), "steps": steps,
+            "asr": round(float(rep["asr"]), 4),
+            "asr_baseline": round(float(rep["asr_baseline"]), 4),
+            "accuracy": round(float(rep["accuracy"]), 4),
+            "loss_final": round(float(metrics["loss"]), 4),
+            "backend": jax.default_backend(),
+            "peak_rss_bytes": peak_rss_bytes(),
+        })
+        print(f"backdoor/{defname:<5} asr={rows[-1]['asr']:.3f} "
+              f"baseline={rows[-1]['asr_baseline']:.3f} "
+              f"acc={rows[-1]['accuracy']:.3f}", flush=True)
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Slot-fused transformer A/B + robustness capture"
+    )
+    p.add_argument("--models", nargs="*", default=None,
+                   help="A/B models (default: vit_tiny gpt_tiny).")
+    p.add_argument("--slots", type=int, default=8,
+                   help="Per-chip worker slots (the fused axis).")
+    p.add_argument("--batch", type=int, default=4,
+                   help="Per-slot batch size.")
+    p.add_argument("--img", type=int, default=16,
+                   help="vit_tiny input side (16 -> 16 patches).")
+    p.add_argument("--reps", type=int, default=5)
+    p.add_argument("--trials", type=int, default=3,
+                   help="Independent timing trials; the committed "
+                        "value is the minimum (VERDICT r4 #3).")
+    p.add_argument("--robust", action="store_true",
+                   help="Token-backdoor ASR cells (gpt_tiny/copytask, "
+                        "defense none vs data) instead of the A/B "
+                        "timing grid.")
+    p.add_argument("--steps", type=int, default=150,
+                   help="--robust: training steps per cell.")
+    p.add_argument("--workers", type=int, default=8,
+                   help="--robust: worker count (f of them poison).")
+    p.add_argument("--f", type=int, default=2,
+                   help="--robust: poisoning cohort size.")
+    p.add_argument("--json", type=str, default=None,
+                   help="Dump rows to this JSON file plus the schema-"
+                        "versioned JSONL twin (one v14 'trans_bench' "
+                        "record per row, tier-1-validated).")
+    args = p.parse_args(argv)
+
+    results = []
+    if args.robust:
+        results.extend(robust_cells(
+            steps=args.steps, num_workers=args.workers, f=args.f,
+        ))
+    else:
+        for name in (args.models or list(AB_MODELS)):
+            cell = ab_cell(
+                name, slots=args.slots, batch=args.batch,
+                img=args.img, reps=args.reps, trials=args.trials,
+            )
+            speedup = (
+                None if not cell["fused"] or not cell["unrolled"]
+                else round(cell["unrolled"] / cell["fused"], 3)
+            )
+            for path in ("fused", "unrolled"):
+                lat = cell[path]
+                row = {
+                    "check": f"{name}/{path}", "model": name,
+                    "path": path, "slots": args.slots, "d": cell["d"],
+                    "seq": cell["seq"], "heads": cell["heads"],
+                    "depth": cell["depth"],
+                    # provenance: the conv dw strategy dominates the
+                    # vit patchify cell on CPU (DESIGN.md §23's
+                    # negative result), so the knob is recorded.
+                    "dw_mode": os.environ.get(
+                        "GARFIELD_SLOTFUSED_DW", "grouped"),
+                    "per_slot_grad_s": (
+                        None if lat is None else lat / args.slots
+                    ),
+                    "speedup": speedup if path == "fused" else None,
+                    "reps": cell[f"{path}_reps"],
+                    "trials": args.trials, "dce_guard": True,
+                    "backend": jax.default_backend(),
+                    "peak_rss_bytes": peak_rss_bytes(),
+                }
+                results.append(row)
+                shown = ("below noise floor" if lat is None else
+                         f"{lat / args.slots * 1e3:8.3f} ms/slot")
+                extra = (f"  speedup {speedup}x"
+                         if path == "fused" and speedup else "")
+                print(f"{name:>9} {path:>8} d={cell['d']:<7} {shown}"
+                      f"{extra}", flush=True)
+
+    if args.json:
+        with open(args.json, "w") as fp:
+            json.dump(results, fp, indent=1)
+        from ...telemetry import exporters
+
+        jsonl_path = os.path.splitext(args.json)[0] + ".jsonl"
+        with exporters.JsonlExporter(jsonl_path) as exp:
+            for row in results:
+                exp.write(exporters.make_record("trans_bench", **row))
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

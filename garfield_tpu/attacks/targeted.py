@@ -19,7 +19,8 @@ poisons its own BATCHES, not its gradient algebra:
     TELEMETRY.md v8 exists to expose).
   - ``backdoor``: ``poison_frac`` of the cohort's samples get a constant
     TRIGGER stamped into a fixed input region (a corner patch on image
-    tasks, the leading features on flat/tabular tasks) and the label set
+    tasks, the leading features on flat/tabular tasks, a fixed token
+    prefix on integer sequence tasks) and the label set
     to ``target`` — BadNets-style. Success is measured as the
     attack-success-rate (ASR): the fraction of non-target test inputs
     that flip to ``target`` once the trigger is stamped
@@ -78,6 +79,7 @@ class TargetedConfig:
     poison_frac: float = 1.0
     trigger_value: float = 2.5
     trigger_size: int = 2
+    trigger_token: int = None
     binary: bool = False
 
     def __post_init__(self):
@@ -99,13 +101,20 @@ class TargetedConfig:
             raise ValueError(
                 f"trigger_size must be >= 1, got {self.trigger_size}"
             )
+        if self.trigger_token is not None and self.trigger_token < 0:
+            raise ValueError(
+                f"trigger_token must be a token id >= 0, got "
+                f"{self.trigger_token}"
+            )
 
 
 def configure(attack, params, *, num_classes):
     """``TargetedConfig`` from an attack name + CLI ``attack_params``.
 
     Recognized params (all optional): ``source`` (default 0), ``target``
-    (default 1), ``poison_frac``, ``trigger_value``, ``trigger_size``.
+    (default 1), ``poison_frac``, ``trigger_value``, ``trigger_size``,
+    ``trigger_token`` (the token id stamped on integer-token batches —
+    see ``apply_trigger``).
     ``num_classes`` is the model head's class count
     (``models.num_classes_dict``); 1 marks the binary single-logit task
     (pima), whose only classes are {0, 1} — a source/target outside that
@@ -142,6 +151,10 @@ def configure(attack, params, *, num_classes):
         poison_frac=float(p.get("poison_frac", 1.0)),
         trigger_value=float(p.get("trigger_value", 2.5)),
         trigger_size=int(p.get("trigger_size", 2)),
+        trigger_token=(
+            None if p.get("trigger_token") is None
+            else int(p["trigger_token"])
+        ),
         binary=binary,
     )
 
@@ -161,14 +174,31 @@ def apply_trigger(cfg, x):
 
     Image batches (..., H, W, C) get a ``trigger_size`` x ``trigger_size``
     corner patch set to ``trigger_value`` (every channel); flat batches
-    (..., D) get their leading ``trigger_size`` features set. Works on
-    numpy arrays AND traced jnp values (pure indexing writes), preserving
-    dtype — the same function stamps the cohort's train batches and the
-    evaluation probes (``parallel.targeted_eval``), so train-time and
-    test-time triggers can never drift apart.
+    (..., D) get their leading ``trigger_size`` features set. INTEGER
+    batches are token sequences (..., T): the leading ``trigger_size``
+    positions become a fixed token PREFIX — ``trigger_token`` if set,
+    else ``round(trigger_value)`` (2.5 -> token 2, which the copytask
+    distractor slots never contain) — the token-space BadNets analogue.
+    The integer test runs FIRST: a stacked token batch (slots, b, T) is
+    ndim 3 but is not an image. Works on numpy arrays AND traced jnp
+    values (pure indexing writes), preserving dtype — the same function
+    stamps the cohort's train batches and the evaluation probes
+    (``parallel.targeted_eval``), so train-time and test-time triggers
+    can never drift apart.
     """
     xp = _xp_of(x)
     t = cfg.trigger_size
+    if np.issubdtype(np.dtype(x.dtype), np.integer):
+        tok = (
+            cfg.trigger_token if cfg.trigger_token is not None
+            else int(round(cfg.trigger_value))
+        )
+        t = min(t, x.shape[-1])
+        if xp is np:
+            out = x.copy()
+            out[..., :t] = x.dtype.type(tok)
+            return out
+        return x.at[..., :t].set(tok).astype(x.dtype)
     v = x.dtype.type(cfg.trigger_value) if xp is np else cfg.trigger_value
     if x.ndim >= 3:
         # (..., H, W, C) image layout: bottom-right corner patch.

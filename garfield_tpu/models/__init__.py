@@ -31,6 +31,7 @@ from .resnext import ResNeXt29_2x64d, ResNeXt29_4x64d, ResNeXt29_8x64d, ResNeXt2
 from .senet import SENet18
 from .shufflenet import ShuffleNetG2, ShuffleNetG3
 from .shufflenetv2 import ShuffleNetV2
+from .transformer import GPT, ViT
 from .vgg import VGG11, VGG13, VGG16, VGG19
 
 __all__ = ["models", "num_classes_dict", "select_model"]
@@ -80,15 +81,22 @@ models = {
     "pnasneta": PNASNetA,
     "pnasnetb": PNASNetB,
     "pimanet": PimaNet,
+    # Transformer family (models/transformer.py): no reference-repo
+    # counterpart — the first-mover slot-fused transformer workloads.
+    # vit_tiny consumes NHWC images; gpt_tiny consumes int token batches
+    # (the copytask sequence dataset).
+    "vit_tiny": ViT,
+    "gpt_tiny": GPT,
 }
 
-# tools.py:89
+# tools.py:89 (+ the synthetic copytask sequence dataset, data/__init__.py)
 num_classes_dict = {
     "cifar10": 10,
     "cifar100": 100,
     "mnist": 10,
     "imagenet": 1000,
     "pima": 1,
+    "copytask": 10,
 }
 
 

@@ -42,7 +42,17 @@ ASSEMBLY over those primitives, registered in ``SLOTFUSED_MODELS``.
 Covered families (all the dropout-free zoo members with a measured win):
 
   ResNet (BasicBlock + Bottleneck) · Cifarnet · VGG (11/13/16/19) ·
-  GoogLeNet/Inception-v1 · MobileNet · MobileNetV2 · DenseNet-BC
+  GoogLeNet/Inception-v1 · MobileNet · MobileNetV2 · DenseNet-BC ·
+  Transformers (ViT-tiny + GPT, tied or untied head)
+
+The transformer twins are the family where the formulation pays most:
+attention is matmul-dominated, every per-slot parameter contraction is
+an 'sbf,sfo->sbo'-shaped einsum (``slotlayers.seq_dense``), the
+attention core itself (``slotlayers.attn_core``) is per-example
+arithmetic shared VERBATIM with the flax modules, LayerNorm statistics
+are per-example (no slot reduction at all — only the affine params are
+worker-resolved), and the embedding's per-slot gradient falls out of a
+slot-vmapped gather's scatter-add transpose.
 
 The twins are functional TWINS of the flax zoo modules: they consume the
 exact flax param/batch_stats trees by name (flax ``nn.compact``
@@ -336,12 +346,86 @@ def _densenet_twin(module):
 
 
 # --------------------------------------------------------------------------
+# Transformer twins (models/transformer.py: ViT-tiny + GPT)
+# --------------------------------------------------------------------------
+
+def _encoder_block(ctx, h, p, heads, causal):
+    """EncoderBlock twin: pre-LN attention + GELU MLP, both residual.
+
+    Mirrors models/transformer.py:EncoderBlock layer for layer — the
+    attention core is the SAME ``sl.attn_core`` callable the flax module
+    traces, so only the per-slot projections (``seq_dense``) and the
+    per-slot LayerNorm affines differ from the unrolled reference.
+    """
+    hn = sl.layer_norm(ctx, h, p["LayerNorm_0"])
+    qkv = sl.seq_dense(ctx, hn, p["Dense_0"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    dim = q.shape[-1]
+    shape = q.shape[:-1] + (heads, dim // heads)
+    a = sl.attn_core(
+        q.reshape(shape), k.reshape(shape), v.reshape(shape), causal=causal
+    )
+    a = a.reshape(a.shape[:-2] + (dim,))
+    h = h + sl.seq_dense(ctx, a, p["Dense_1"])
+    hn = sl.layer_norm(ctx, h, p["LayerNorm_1"])
+    m = sl.gelu(sl.seq_dense(ctx, hn, p["Dense_2"]))
+    return h + sl.seq_dense(ctx, m, p["Dense_3"])
+
+
+def _vit_twin(module):
+    patch, dim = int(module.patch), int(module.dim)
+    heads, depth = int(module.heads), int(module.depth)
+
+    def forward(ctx, p_st, stats, x):
+        del stats
+        h = sl.conv(ctx, x.astype(ctx.dtype), p_st["Conv_0"], patch, 0)
+        h = h.reshape(h.shape[0], -1, dim)
+        h = sl.pos_embed(ctx, h, p_st["pos_embedding"])
+        for i in range(depth):
+            h = _encoder_block(
+                ctx, h, p_st[f"EncoderBlock_{i}"], heads, False
+            )
+        h = sl.layer_norm(ctx, h, p_st["LayerNorm_0"])
+        h = jnp.mean(h, axis=1)
+        return sl.dense(ctx, h, p_st["Dense_0"]), {}
+
+    return forward
+
+
+def _gpt_twin(module):
+    heads, depth = int(module.heads), int(module.depth)
+    tied = bool(module.tied)
+
+    def forward(ctx, p_st, stats, x):
+        del stats
+        h = sl.embed(ctx, x, p_st["Embed_0"]["embedding"])
+        h = sl.pos_embed(ctx, h, p_st["pos_embedding"])
+        for i in range(depth):
+            h = _encoder_block(
+                ctx, h, p_st[f"EncoderBlock_{i}"], heads, True
+            )
+        h = sl.layer_norm(ctx, h, p_st["LayerNorm_0"])
+        h = h[:, -1]
+        if tied:
+            # Embedding-tied head (nn.Embed.attend): a per-slot einsum
+            # against the SAME stacked table — autodiff accumulates its
+            # cotangent into the embedding's per-slot gradient alongside
+            # the lookup's scatter-add, exactly like the unrolled path.
+            h3 = h.reshape(ctx.slots, ctx.nb, -1).astype(ctx.dtype)
+            emb = p_st["Embed_0"]["embedding"].astype(ctx.dtype)
+            return jnp.einsum("sbf,svf->sbv", h3, emb), {}
+        return sl.dense(ctx, h, p_st["Dense_0"]), {}
+
+    return forward
+
+
+# --------------------------------------------------------------------------
 # Registry + dispatch
 # --------------------------------------------------------------------------
 
 def _registry():
     from . import densenet, googlenet, mobilenet, mobilenetv2, nets, \
-        resnet, vgg
+        resnet, transformer, vgg
 
     return {
         resnet.ResNet: _resnet_twin,
@@ -351,6 +435,8 @@ def _registry():
         mobilenet.MobileNet: _mobilenet_twin,
         mobilenetv2.MobileNetV2: _mobilenetv2_twin,
         densenet.DenseNet: _densenet_twin,
+        transformer.ViT: _vit_twin,
+        transformer.GPT: _gpt_twin,
     }
 
 

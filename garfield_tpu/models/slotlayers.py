@@ -15,7 +15,29 @@ the ``SLOTFUSED_MODELS`` registry live in ``slotfused.py``):
     depthwise families (mobilenet/v2) fold too.
   - ``bn_train``        — per-slot BatchNorm statistics over the flat
     batch, flax-numerics-compatible (f32 stats, compute-dtype normalize).
+  - ``layer_norm``      — per-example feature-axis statistics (flax
+    ``nn.LayerNorm`` numerics: f32 fast-variance stats, compute-dtype
+    normalize) with PER-SLOT scale/bias; the stats need no slot
+    resolution — only the affine parameters are worker-resolved.
   - ``dense``           — slot-batched matmul head ('sbf,sfo->sbo').
+  - ``seq_dense``       — the sequence-layout sibling: (slots*b, T, F)
+    through a per-slot kernel via the same 'sbf,sfo->sbo' einsum with T
+    folded into the batch rows.
+  - ``attn_core``       — the multi-head attention core (QK^T -> masked
+    softmax -> PV) on per-example arithmetic, SHARED VERBATIM by the
+    flax transformer modules and the slot twins (models/transformer.py
+    imports it), so the fused flat batch and the unrolled per-slot
+    reference run bit-identical attention math. Softmax statistics in
+    f32 with an explicit in-order add chain for the denominator (the
+    GARFIELD_SORTNET-era bitwise discipline: no backend reassociation),
+    and a finite large-negative causal mask (never -inf — a masked-row
+    ``exp(-inf - -inf)`` NaNs).
+  - ``embed`` / ``pos_embed`` — token-embedding gather from the STACKED
+    table (the autodiff transpose is a per-slot scatter-add — the
+    embedding's per-slot gradient) and the learned-positional broadcast
+    add (transpose: per-slot sum over the batch rows).
+  - ``gelu``            — re-exported ``jax.nn.gelu`` so model and twin
+    share one callable.
   - ``bias_add``        — per-slot bias broadcast onto the flat batch.
   - ``max_pool`` / ``avg_pool`` / ``global_avg_pool`` — plain flat-batch
     ops (no slot resolution needed; kept here so twins import one module).
@@ -63,7 +85,14 @@ __all__ = [
     "slot_conv",
     "conv",
     "bn_train",
+    "layer_norm",
     "dense",
+    "seq_dense",
+    "attn_core",
+    "softmax_chain",
+    "embed",
+    "pos_embed",
+    "gelu",
     "bias_add",
     "relu",
     "max_pool",
@@ -326,6 +355,144 @@ def dense(ctx, x2, p_st):
     if "bias" in p_st:
         y = y + p_st["bias"].astype(ctx.dtype)[:, None, :]
     return y
+
+
+def seq_dense(ctx, x, p_st):
+    """Sequence-layout dense: (slots*b, T, F) @ per-slot kernel.
+
+    The T axis folds into the per-slot batch rows, so this is the same
+    MXU-native 'sbf,sfo->sbo' contraction as ``dense`` — flax
+    ``nn.Dense`` on (b, T, F) contracts the last dim identically, so
+    the twin-vs-unroll difference is only the slot batching of the
+    kernel operand. Returns (slots*b, T, O).
+    """
+    T = x.shape[1]
+    x3 = x.reshape(ctx.slots, ctx.nb * T, -1).astype(ctx.dtype)
+    y = jnp.einsum("sbf,sfo->sbo", x3, p_st["kernel"].astype(ctx.dtype))
+    if "bias" in p_st:
+        y = y + p_st["bias"].astype(ctx.dtype)[:, None, :]
+    return y.reshape(ctx.slots * ctx.nb, T, -1)
+
+
+# --------------------------------------------------------------------------
+# Transformer primitives: LayerNorm / attention / embeddings
+# --------------------------------------------------------------------------
+
+#: Finite large-negative causal-mask value. NOT -inf: a masked score of
+#: -inf makes ``exp(s - max)`` evaluate ``exp(-inf - -inf)`` = NaN the
+#: moment a row is fully masked, and the softmax add chain propagates it.
+#: exp(-1e30 - m) underflows to exact 0.0 in f32 and f64, so masked
+#: positions contribute nothing to the denominator deterministically.
+MASK_VALUE = -1e30
+
+#: One shared GELU for models and twins (tanh approximation, the
+#: ``jax.nn.gelu`` default) — sharing the callable is what keeps the
+#: fused and unrolled pipelines on identical elementwise arithmetic.
+gelu = jax.nn.gelu
+
+
+def softmax_chain(s):
+    """Softmax over the last axis with an EXPLICIT in-order add chain.
+
+    Max-subtracted for range safety (statistics stay in the operand's
+    dtype — callers promote to at least f32 first, the attention-numerics
+    rule), with the denominator built as ``e_0 + e_1 + ... + e_{T-1}`` in
+    index order instead of a ``jnp.sum`` the backend may reassociate —
+    the same in-order-adds discipline ``slot_reduce``'s segsum mode pins,
+    so fused-vs-unrolled softmax rows agree bitwise for any schedule.
+    No zero-denominator guard is needed: the max subtraction guarantees
+    one exact ``exp(0) = 1`` term per row.
+    """
+    m = jax.lax.stop_gradient(jnp.max(s, axis=-1, keepdims=True))
+    e = jnp.exp(s - m)
+    acc = e[..., 0]
+    for t in range(1, e.shape[-1]):
+        acc = acc + e[..., t]
+    return e / acc[..., None]
+
+
+def attn_core(q, k, v, causal=False):
+    """Multi-head attention core on (..., T, H, Dh) q/k/v.
+
+    Per-EXAMPLE arithmetic only — no slot resolution anywhere — so the
+    flax transformer modules (models/transformer.py) call this exact
+    function on (b, T, H, Dh) while the twins call it on the flat
+    (slots*b, T, H, Dh): fused and unrolled attention are the same
+    traced ops, and the twin equality pins only have to absorb the
+    per-slot QKV/out projections around it.
+
+    Numerics per the attention playbook: QK^T accumulates in (at least)
+    f32 via ``preferred_element_type``, softmax statistics stay in that
+    width (``softmax_chain``: max-subtract + in-order add chain), the
+    causal mask is a finite ``MASK_VALUE`` where-select over an iota
+    row/col comparison, and the probabilities are cast back to the
+    compute dtype only for the PV contraction.
+    """
+    dh = q.shape[-1]
+    sf = jnp.promote_types(jnp.float32, q.dtype)
+    s = jnp.einsum(
+        "...qhd,...khd->...hqk", q, k, preferred_element_type=sf
+    ) * (1.0 / float(np.sqrt(dh)))
+    if causal:
+        T = s.shape[-1]
+        row = lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        col = lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        s = jnp.where(col <= row, s, jnp.asarray(MASK_VALUE, s.dtype))
+    p = softmax_chain(s)
+    return jnp.einsum("...hqk,...khd->...qhd", p.astype(q.dtype), v)
+
+
+def layer_norm(ctx, x, p_st, eps=1e-6):
+    """Per-slot-affine LayerNorm over the flat batch, flax numerics.
+
+    The statistics are PER-EXAMPLE (feature-axis mean/fast-variance in
+    at least f32, negative variances clipped — flax ``_compute_stats``),
+    so unlike ``bn_train`` they need no slot resolution at all; only the
+    scale/bias application is worker-resolved, via ``slot_expand``
+    (whose autodiff transpose is the per-slot segment reduction — the
+    per-slot LayerNorm parameter gradients). Association matches flax
+    ``_normalize`` exactly: ``y = (x - mean) * (rsqrt(var + eps) *
+    scale) + bias``, cast to the compute dtype at the end.
+    """
+    xf = x.astype(jnp.promote_types(jnp.float32, x.dtype))
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.maximum(
+        0.0, jnp.mean(xf * xf, axis=-1, keepdims=True) - mu * mu
+    )
+    sd = x.ndim - 2
+    mul = lax.rsqrt(var + eps) * slot_expand(
+        ctx, p_st["scale"].astype(xf.dtype), sd
+    )
+    y = (xf - mu) * mul + slot_expand(
+        ctx, p_st["bias"].astype(xf.dtype), sd
+    )
+    return y.astype(ctx.dtype)
+
+
+def embed(ctx, tok, emb_st):
+    """Token-embedding lookup from the STACKED (slots, vocab, D) table.
+
+    Forward gathers each slot's rows from its own table copy (all rows
+    equal by construction, so the values match the fused single-table
+    lookup flax ``nn.Embed`` performs); the autodiff transpose of the
+    slot-vmapped gather is a per-slot scatter-add — exactly the
+    per-worker embedding gradient, with no custom vjp needed.
+    """
+    tok3 = tok.reshape((ctx.slots, ctx.nb) + tok.shape[1:])
+    out = jax.vmap(lambda tab, t: jnp.take(tab, t, axis=0))(
+        emb_st.astype(ctx.dtype), tok3
+    )
+    return out.reshape((ctx.slots * ctx.nb,) + out.shape[2:])
+
+
+def pos_embed(ctx, x, pos_st):
+    """Add learned per-slot positional embeddings (slots, T, D) onto the
+    flat (slots*b, T, D) activations. The (slots, nb) view is free; the
+    broadcast-add's transpose is a per-slot sum over the nb rows — the
+    positional table's per-worker gradient."""
+    xs = x.reshape((ctx.slots, ctx.nb) + x.shape[1:])
+    y = xs + pos_st[:, None].astype(ctx.dtype)
+    return y.reshape(x.shape)
 
 
 def bias_add(ctx, x, b_st):

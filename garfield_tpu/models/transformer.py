@@ -1,0 +1,155 @@
+"""Dropout-free transformer workloads: ViT-tiny and a small GPT.
+
+The reference Garfield repo has no transformer machinery anywhere (its
+workloads are the CNN zoo + the Pima tabular task), so this family is a
+first-mover addition: Byzantine-robust DISTRIBUTED transformer training
+on the slot-fused fast path (ROADMAP item: "slot-fused transformers").
+Both models are deliberately dropout-free — the slot-fused gradient
+twins (models/slotfused.py) cannot replicate flax's internal rng-path
+folding, so like the rest of the twin-covered zoo the stochastic
+regularizers stay out and equality against the unrolled per-slot
+reference remains verifiable.
+
+Design constraints the twins dictate:
+
+  - every layer is an auto-named ``nn.compact`` submodule (``Conv_i`` /
+    ``Dense_i`` / ``LayerNorm_i`` / ``EncoderBlock_i`` in creation
+    order), so the twin mirrors the param tree by name;
+  - the attention core (QK^T -> masked softmax -> PV) is
+    ``slotlayers.attn_core`` — the SAME callable the twins trace, so
+    fused and unrolled attention arithmetic can never drift (finite
+    causal mask, f32 softmax statistics, in-order add-chain
+    denominator);
+  - ``ViT`` has no class token: patchify (``nn.Conv``, stride = patch)
+    + learned positional embeddings + pre-LN encoder blocks + mean-pool
+    + Dense head. The class token would be one more concat for zero
+    test signal at this scale.
+  - ``GPT`` is causal: token embedding (``nn.Embed``) + learned
+    positional embeddings + pre-LN causal blocks + final LayerNorm,
+    classifying from the LAST position's hidden state so the standard
+    ``(logits, labels)`` losses and ``parallel.targeted_eval`` apply
+    unchanged. ``tied=True`` reuses the embedding table as the output
+    head (``nn.Embed.attend``) — the layout ``aggregators.dataplane``
+    must REFUSE to fingerprint (no untied classifier head to locate).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from . import slotlayers as sl
+
+__all__ = ["EncoderBlock", "ViT", "GPT"]
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN transformer block: x + Attn(LN(x)); x + MLP(LN(x)).
+
+    Creation order (the twin's contract): LayerNorm_0, Dense_0 (fused
+    QKV), Dense_1 (out projection), LayerNorm_1, Dense_2 / Dense_3
+    (GELU MLP). ``causal`` selects the masked attention variant.
+    """
+
+    dim: int
+    heads: int
+    mlp_dim: int
+    causal: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        dh = self.dim // self.heads
+        shape = q.shape[:-1] + (self.heads, dh)
+        a = sl.attn_core(
+            q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            causal=self.causal,
+        )
+        a = a.reshape(a.shape[:-2] + (self.dim,))
+        x = x + nn.Dense(self.dim, dtype=self.dtype)(a)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
+        h = sl.gelu(h)
+        return x + nn.Dense(self.dim, dtype=self.dtype)(h)
+
+
+class ViT(nn.Module):
+    """ViT-tiny for CIFAR-scale inputs: patchify -> encoder -> mean-pool.
+
+    With the defaults on 32x32x3 inputs: 8x8 = 64 patches of 4x4, width
+    48 over 3 heads (d_head 16) — the "attention-shaped d" regime the
+    selection benchmarks bucket as heads * d_head * seq = 3072.
+    """
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    patch: int = 4
+    dim: int = 48
+    depth: int = 2
+    heads: int = 3
+    mlp_dim: int = 96
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        del train  # dropout-free (twin-equality contract)
+        h = nn.Conv(
+            self.dim, (self.patch, self.patch),
+            strides=(self.patch, self.patch), padding="VALID",
+            dtype=self.dtype,
+        )(x)
+        h = h.reshape(h.shape[0], -1, self.dim)  # (b, T, D)
+        pos = self.param(
+            "pos_embedding", nn.initializers.normal(0.02),
+            (h.shape[1], self.dim),
+        )
+        h = h + pos[None].astype(self.dtype)
+        for _ in range(self.depth):
+            h = EncoderBlock(
+                self.dim, self.heads, self.mlp_dim, causal=False,
+                dtype=self.dtype,
+            )(h)
+        h = nn.LayerNorm(dtype=self.dtype)(h)
+        h = jnp.mean(h, axis=1)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(h)
+
+
+class GPT(nn.Module):
+    """Small causal transformer classifying from the last position.
+
+    Consumes int token batches (b, T); the default vocab matches the
+    ``copytask`` sequence dataset (data/__init__.py). ``tied=True``
+    swaps the Dense head for ``nn.Embed.attend`` against the embedding
+    table (logits over the vocab) — the embedding-tied layout the
+    data-plane defense refuses loudly (``aggregators.dataplane``).
+    """
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    vocab: int = 32
+    dim: int = 48
+    depth: int = 2
+    heads: int = 3
+    mlp_dim: int = 96
+    tied: bool = False
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        del train  # dropout-free (twin-equality contract)
+        emb = nn.Embed(self.vocab, self.dim, dtype=self.dtype)
+        h = emb(x)  # (b, T, D)
+        pos = self.param(
+            "pos_embedding", nn.initializers.normal(0.02),
+            (x.shape[-1], self.dim),
+        )
+        h = h + pos[None].astype(self.dtype)
+        for _ in range(self.depth):
+            h = EncoderBlock(
+                self.dim, self.heads, self.mlp_dim, causal=True,
+                dtype=self.dtype,
+            )(h)
+        h = nn.LayerNorm(dtype=self.dtype)(h)
+        h = h[:, -1]
+        if self.tied:
+            return emb.attend(h)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(h)

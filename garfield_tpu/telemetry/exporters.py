@@ -144,11 +144,22 @@ SCHEMA = "garfield-telemetry"
 # counts, p50/p95/p99 round latency from the trace plane, the
 # failover/partition/epoch accounting, and the measured
 # ``kill_cost_rounds`` for the mid-round-kill SLO).
-SCHEMA_VERSION = 13
+# v14 (round 21, slot-fused transformers — DESIGN.md §23): the new
+# ``trans_bench`` kind (TRANSBENCH_r*'s rows). Two row families share
+# it: A/B rows (one ``model`` x ``path`` cell — path ``fused`` is the
+# slot-fused twin, ``unrolled`` the per-slot reference loop — with
+# ``per_slot_grad_s``, ``speedup`` on the fused row, and the gar_bench
+# rep/trial/dce-guard columns) and robustness rows (``cell`` names the
+# scenario — e.g. ``backdoor/none`` vs ``backdoor/data`` — with
+# ``asr``, ``asr_baseline`` (the v9 attribution discipline: report
+# attributable lift, not raw rate), ``accuracy`` and ``defense``).
+# ``gar_bench`` --selection rows additionally sweep the
+# attention-shaped d regimes (heads * d_head * seq) — no new fields.
+SCHEMA_VERSION = 14
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
          "transfer_bench", "exchange_bench", "hier_bench", "span",
-         "defense_bench", "fed_bench", "soak_bench")
+         "defense_bench", "fed_bench", "soak_bench", "trans_bench")
 
 
 def make_record(kind, **fields):
@@ -957,6 +968,67 @@ def validate_record(rec):
             _fail(
                 f"soak_bench.bitwise_equal must be a bool or null, "
                 f"got {bw!r}"
+            )
+    elif kind == "trans_bench":
+        # v14: one TRANSBENCH_r* row — either an A/B cell (fused twin
+        # vs unrolled per-slot reference on a transformer model) or a
+        # robustness/backdoor cell (ASR with baseline attribution).
+        if not isinstance(rec.get("check"), str) or not rec["check"]:
+            _fail(
+                f"trans_bench.check must be a non-empty string, got "
+                f"{rec.get('check')!r}"
+            )
+        if not isinstance(rec.get("model"), str) or not rec["model"]:
+            _fail(
+                f"trans_bench.model must be a non-empty string, got "
+                f"{rec.get('model')!r}"
+            )
+        for key in ("slots", "d"):
+            val = rec.get(key)
+            if not isinstance(val, int) or isinstance(val, bool) \
+                    or val < 1:
+                _fail(
+                    f"trans_bench.{key} must be a positive int, got "
+                    f"{val!r}"
+                )
+        for key in ("path", "cell", "defense", "backend"):
+            val = rec.get(key)
+            if val is not None and not isinstance(val, str):
+                _fail(
+                    f"trans_bench.{key} must be a string or null, got "
+                    f"{val!r}"
+                )
+        for key in ("seq", "heads", "depth", "reps", "trials", "steps"):
+            val = rec.get(key)
+            if val is not None and (
+                not isinstance(val, int) or isinstance(val, bool)
+                or val < 0
+            ):
+                _fail(
+                    f"trans_bench.{key} must be a non-negative int or "
+                    f"null, got {val!r}"
+                )
+        for key in ("per_slot_grad_s", "speedup", "asr", "asr_baseline",
+                    "accuracy"):
+            val = rec.get(key)
+            if val is not None and not _is_num(val):
+                _fail(
+                    f"trans_bench.{key} must be a number or null, "
+                    f"got {val!r}"
+                )
+        dg = rec.get("dce_guard")
+        if dg is not None and not isinstance(dg, bool):
+            _fail(
+                f"trans_bench.dce_guard must be a bool or null, got "
+                f"{dg!r}"
+            )
+        rss = rec.get("peak_rss_bytes")
+        if rss is not None and (
+            not isinstance(rss, int) or isinstance(rss, bool) or rss < 0
+        ):
+            _fail(
+                f"trans_bench.peak_rss_bytes must be a non-negative int "
+                f"or null, got {rss!r}"
             )
     elif kind == "transfer_bench":
         for key in ("devices", "d"):

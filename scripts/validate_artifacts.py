@@ -35,7 +35,12 @@ and the v13 control-plane additions (the ``soak_bench`` kind behind
 SOAKBENCH_r*'s steady / rolling_restart / partition / churn rows with
 their p50/p95/p99 SLO columns and the measured ``kill_cost_rounds``,
 plus the ``membership`` event — one epoch bump per failover / split /
-merge; both auto-globbed like every ``*_r*.jsonl``).
+merge; both auto-globbed like every ``*_r*.jsonl``) — and the v14
+slot-fused-transformer additions (the ``trans_bench`` kind behind
+TRANSBENCH_r*'s rows: fused-vs-unrolled A/B latency cells with their
+``dw_mode``/``dce_guard``/``per_slot_grad_s``/``speedup`` columns and
+the token-backdoor robustness cells with ``asr``/``asr_baseline``/
+``accuracy`` per defense; auto-globbed like every ``*_r*.jsonl``).
 
   python scripts/validate_artifacts.py            # repo root auto-found
   python scripts/validate_artifacts.py /some/repo

@@ -245,6 +245,47 @@ class TestTargeted:
         np.testing.assert_array_equal(np.asarray(xj), xn)
         np.testing.assert_array_equal(np.asarray(yj), yn)
 
+    def test_backdoor_token_prefix_on_integer_batches(self):
+        from garfield_tpu.attacks import targeted
+
+        cfg = self._cfg(attack="backdoor", trigger_token=14,
+                        trigger_size=2)
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, 10, size=(5, 8)).astype(np.int32)
+        y = (np.arange(5) % 3).astype(np.int32)
+        x2, y2 = targeted.poison_batch(cfg, x, y, seed=0)
+        assert (x2[:, :2] == 14).all()  # token PREFIX, not a pixel patch
+        np.testing.assert_array_equal(x2[:, 2:], x[:, 2:])
+        np.testing.assert_array_equal(y2, np.ones(5, np.int32))
+        assert x2.dtype == np.int32
+
+    def test_apply_trigger_stacked_tokens_default_and_parity(self):
+        from garfield_tpu.attacks import targeted
+
+        # No trigger_token: integer batches fall back to
+        # round(trigger_value) = 2. A stacked (slots, b, T) int batch is
+        # ndim 3 like an image (H, W, C) — the integer check must win.
+        cfg = self._cfg(attack="backdoor", trigger_size=2)
+        x = np.full((3, 4, 6), 7, np.int32)
+        x2 = targeted.apply_trigger(cfg, x)
+        assert (x2[..., :2] == 2).all()
+        assert (x2[..., 2:] == 7).all()
+        assert x2.dtype == np.int32
+        xj = targeted.apply_trigger(cfg, jnp.asarray(x))
+        np.testing.assert_array_equal(np.asarray(xj), x2)
+
+    def test_configure_trigger_token(self):
+        from garfield_tpu.attacks import targeted
+
+        cfg = targeted.configure(
+            "backdoor", {"trigger_token": "14"}, num_classes=10
+        )
+        assert cfg.trigger_token == 14
+        with pytest.raises(ValueError, match="trigger_token"):
+            targeted.configure(
+                "backdoor", {"trigger_token": -1}, num_classes=10
+            )
+
     def test_configure_validates(self):
         from garfield_tpu.attacks import targeted
 

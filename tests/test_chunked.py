@@ -184,6 +184,41 @@ def test_targeted_partial_poison_chunked_bitwise():
     _compare(init_fn, step_fn, ks=(1, 4, 8))
 
 
+def test_gpt_token_backdoor_chunked_bitwise():
+    """The transformer family through the chunked dispatch (DESIGN.md
+    §23): a small GPT on integer token batches, with the token-prefix
+    backdoor poisoning the Byzantine slots' batches in-graph — K-step
+    chunks must stay bitwise equal to per-step, token poisoning, twin
+    gradients and all (poison_frac 1.0 keeps the mask static, so the
+    program carries no mask RNG — the same contract the pima rows pin).
+    """
+    from garfield_tpu.models import transformer
+
+    module = transformer.GPT(
+        num_classes=10, vocab=16, dim=16, depth=1, heads=2, mlp_dim=32
+    )
+    loss = selectors.select_loss("nll")
+    opt = selectors.select_optimizer("sgd", lr=0.05, momentum=0.9)
+    init_fn, step_fn, _ = aggregathor.make_trainer(
+        module, loss, opt, "krum", num_workers=8, f=2, attack="backdoor",
+        attack_params={"source": 0, "target": 3, "trigger_token": 14,
+                       "trigger_size": 2},
+    )
+    rng = np.random.default_rng(9)
+    xs = jnp.asarray(
+        rng.integers(0, 16, size=(8, NUM_BATCHES, 8, 6)).astype(np.int32)
+    )
+    ys = jnp.asarray(
+        rng.integers(0, 10, size=(8, NUM_BATCHES, 8)).astype(np.int32)
+    )
+    state0 = init_fn(jax.random.PRNGKey(0), xs[0, 0])
+    ref_state, ref_metrics = _run_per_step(step_fn, state0, xs, ys)
+    for K in (1, 4, 8):
+        got_state, got_metrics = _run_chunked(step_fn, state0, xs, ys, K)
+        _assert_bitwise_equal(ref_state, got_state)
+        _assert_bitwise_equal(ref_metrics, got_metrics)
+
+
 def test_make_chunked_step_validates():
     module, loss, opt = _setup()
     init_fn, step_fn, _ = aggregathor.make_trainer(

@@ -90,6 +90,106 @@ def test_head_spec_and_extraction_agree():
     np.testing.assert_allclose(np.asarray(b_tree), b_rows, rtol=1e-6)
 
 
+def test_transformer_head_resolution_vit():
+    """ViT's head must resolve to the top-level Dense, NOT the
+    ``pos_embedding`` table — flax flattens by sorted string key, so the
+    lowercase positional param lands AFTER every capitalized module
+    scope and the legacy "last 2-D leaf" rule would fingerprint it."""
+    from garfield_tpu.models import transformer
+
+    vit = transformer.ViT(dim=24, depth=2, heads=2, mlp_dim=48)
+    p = vit.init(
+        jax.random.PRNGKey(0), np.zeros((2, 16, 16, 3), np.float32)
+    )["params"]
+    spec = dp.head_spec(p)
+    assert spec.feat == 24 and spec.classes == 10
+    assert spec.bias is not None
+    stacked = jax.tree.map(lambda l: jnp.stack([l, 2.0 * l]), p)
+    k_tree, b_tree = dp.head_leaves(stacked)
+    assert k_tree.shape == (2, 10, 24) and b_tree.shape == (2, 10)
+    # Identity to the actual head params (class-major transpose), and
+    # wire-path agreement with the in-graph extraction.
+    np.testing.assert_allclose(
+        np.asarray(k_tree[0]), np.asarray(p["Dense_0"]["kernel"]).T,
+        rtol=1e-6,
+    )
+    rows = core.flatten_rows(stacked)
+    k_rows, b_rows = dp.head_from_rows(spec, np.asarray(rows))
+    np.testing.assert_allclose(np.asarray(k_tree), k_rows, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(b_tree), b_rows, rtol=1e-6)
+
+
+def test_transformer_head_resolution_gpt_untied():
+    """Untied GPT: the top-level Dense head wins over both the nested
+    ``EncoderBlock_*`` MLP kernels and the ``nn.Embed`` table."""
+    from garfield_tpu.models import transformer
+
+    gpt = transformer.GPT(vocab=16, dim=16, depth=1, heads=2, mlp_dim=32)
+    p = gpt.init(
+        jax.random.PRNGKey(0), np.zeros((2, 6), np.int32)
+    )["params"]
+    spec = dp.head_spec(p)
+    assert spec.feat == 16 and spec.classes == 10
+    assert spec.bias is not None
+    stacked = jax.tree.map(lambda l: jnp.stack([l, -l]), p)
+    k_tree, _ = dp.head_leaves(stacked)
+    np.testing.assert_allclose(
+        np.asarray(k_tree[0]), np.asarray(p["Dense_0"]["kernel"]).T,
+        rtol=1e-6,
+    )
+
+
+def test_tied_gpt_head_refuses_loudly():
+    """GPT(tied=True) has NO head distinct from the embedding gradient:
+    both the host and the in-graph resolvers must refuse with a clear
+    error instead of silently fingerprinting an interior MLP kernel."""
+    from garfield_tpu.models import transformer
+
+    gpt = transformer.GPT(
+        vocab=16, dim=16, depth=1, heads=2, mlp_dim=32, tied=True
+    )
+    p = gpt.init(
+        jax.random.PRNGKey(0), np.zeros((2, 6), np.int32)
+    )["params"]
+    with pytest.raises(ValueError, match="embedding-tied"):
+        dp.head_spec(p)
+    stacked = jax.tree.map(lambda l: jnp.stack([l, l]), p)
+    with pytest.raises(ValueError, match="embedding-tied"):
+        dp.head_leaves(stacked)
+
+
+def test_suspect_class_robust_to_small_cohort():
+    """At f/n = 1/4 a coherent cohort caps its own mean/std z at
+    ~sqrt((n-f)/f) = 1.73 (it corrupts the mean and inflates the std of
+    the class it attacks), so one noisy honest rank in a quiet class
+    outscored the true target and steered the 2-means at clean rows.
+    The median/MAD statistic must keep pointing at the target class."""
+    rng = np.random.default_rng(0)
+    kern = rng.normal(size=(8, 10, 4)).astype(np.float32)
+    b = 0.05 * rng.normal(size=(8, 10)).astype(np.float32)
+    b[6:, 3] = -0.9  # coherent 2-of-8 cohort on the target class
+    b[1, 7] = 0.4  # one loud honest rank elsewhere
+    assert int(dp.suspect_class(kern, b)) == 3
+    assert int(dp.suspect_class(jnp.asarray(kern), jnp.asarray(b))) == 3
+
+
+def test_detect_flags_small_cohort():
+    """2-of-8 coherent target-class cohort — the realistic fine-tuning
+    quorum shape the spectral tail alone cannot reach (its score is
+    rms-normalized by a crowd the cohort itself inflates, bounded by
+    sqrt(n/f) = 2.0 = tau): the cluster path must carry it."""
+    rng = np.random.default_rng(1)
+    H = 0.1 * rng.normal(size=(8, 10, 16)).astype(np.float32)
+    b = 0.05 * rng.normal(size=(8, 10)).astype(np.float32)
+    coh = rng.normal(size=(16,)).astype(np.float32)
+    for i in (6, 7):
+        H[i, 3] = 4.0 * coh + 0.02 * rng.normal(size=(16,))
+        b[i, 3] = -0.9
+    _, flags = dp.detect(H, b, f=2)
+    assert flags[6:].all(), f"cohort not flagged: {flags}"
+    assert not flags[:6].any(), f"honest ranks flagged: {flags}"
+
+
 def test_detectors_flag_coherent_cohort_not_clean():
     H, b = _cohort_heads(seed=0, f=3)
     scores, flags = dp.detect(H, b, f=3)

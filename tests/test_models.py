@@ -23,9 +23,11 @@ def test_registry_covers_reference_names():
 
 
 def test_num_classes_dict_parity():
-    # garfieldpp/tools.py:89
+    # garfieldpp/tools.py:89 — plus copytask, the token-sequence task
+    # behind the transformer family (no reference counterpart).
     assert models.num_classes_dict == {
         "cifar10": 10, "cifar100": 100, "mnist": 10, "imagenet": 1000, "pima": 1,
+        "copytask": 10,
     }
 
 

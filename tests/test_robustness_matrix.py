@@ -435,3 +435,111 @@ def test_targeted_attack_raises_asr_not_divergence(attack):
     finally:
         os.environ.pop("GARFIELD_SURROGATE_MARGIN", None)
         data_lib._warned_synthetic.clear()
+
+
+# --- transformer-family rows (DESIGN.md §23) --------------------------------
+#
+# The matrix above runs on synthetic Gaussian stacks; these rows run the
+# same contract on REAL transformer gradients: per-worker grads of the
+# small GPT on token batches (the slot-fused twin's workload), flattened
+# to (n, d) rows. Real gradient stacks are anisotropic — per-leaf scales
+# spread orders of magnitude — so the tolerance is set from the stack's
+# own measured honest spread, at the matrix's 5x multiplier.
+
+TRANS_RULES = ["krum", "median", "cclip"]
+_GPT_ROWS_CACHE = []
+
+
+def _gpt_rows():
+    """(n, d) float32 per-worker GPT gradient rows, computed once."""
+    if not _GPT_ROWS_CACHE:
+        from garfield_tpu.models import transformer
+        from garfield_tpu.parallel import core as pcore
+        from garfield_tpu.utils import selectors
+
+        module = transformer.GPT(
+            num_classes=10, vocab=16, dim=16, depth=1, heads=2,
+            mlp_dim=32,
+        )
+        loss = selectors.select_loss("nll")
+        init_fn, grad_fn, _ = pcore.make_worker_fns(module, loss)
+        k = jax.random.PRNGKey(0)
+        x = jax.random.randint(k, (N, 4, 8), 0, 16)
+        y = jax.random.randint(jax.random.fold_in(k, 1), (N, 4), 0, 10)
+        keys = jax.random.split(jax.random.PRNGKey(2), N)
+        params, ms = init_fn(k, x[0])
+        g_st, _ = jax.vmap(
+            grad_fn, in_axes=(None, None, 0, 0, 0)
+        )(params, ms, x, y, keys)
+        rows = np.stack([
+            np.asarray(jax.flatten_util.ravel_pytree(
+                jax.tree.map(lambda l: l[i], g_st)
+            )[0], np.float32)
+            for i in range(N)
+        ])
+        _GPT_ROWS_CACHE.append(rows)
+    return _GPT_ROWS_CACHE[0]
+
+
+def _maybe_stale(rows, mode):
+    if mode != "async":
+        return rows
+    from garfield_tpu.utils import rounds
+
+    taus = np.zeros(N, np.int64)
+    taus[1] = 2  # one stale honest rank, discounted not dropped
+    w = rounds.staleness_weights(taus, decay=0.5, max_staleness=4)
+    return rows * jnp.asarray(w)[:, None]
+
+
+@pytest.mark.parametrize("mode", ["sync", "async"])
+@pytest.mark.parametrize("attack", ["lie", "adaptive-lie", "none"])
+@pytest.mark.parametrize("rule", TRANS_RULES)
+def test_transformer_rows_bounded(rule, attack, mode):
+    """krum/median/cclip x lie/adaptive-lie/none x sync/async on real
+    GPT gradient rows: the robust aggregate stays within a few measured
+    honest-spread lengths of the honest mean — the Byzantine-tolerance
+    contract carries to the transformer family's gradient geometry."""
+    rows = _gpt_rows()
+    hm = rows[: N - F].mean(axis=0)
+    spread = float(
+        np.linalg.norm(rows[: N - F] - hm, axis=1).mean()
+    )
+    tol = 5.0 * spread
+    mask = jnp.arange(N) >= N - F
+    if attack == "adaptive-lie":
+        from garfield_tpu.attacks import adaptive
+
+        cfg = adaptive.configure(
+            "adaptive-lie", {"mag_max": 6.0}, num_workers=N, f=F
+        )
+        lo, hi = cfg.mag_min, cfg.mag_max
+        errs = []
+        for _ in range(16):
+            z = float(adaptive.played_magnitude(lo, hi))
+            attacked = _maybe_stale(apply_gradient_attack(
+                "lie", jnp.asarray(rows), mask, z=z
+            ), mode)
+            agg = np.asarray(gars[rule].unchecked(attacked, f=F))
+            u = np.asarray(attacked[N - 1]) - hm
+            frac = float(np.dot(agg - hm, u) / max(np.dot(u, u), 1e-12))
+            lo, hi = (float(v) for v in adaptive.update_bracket(
+                lo, hi, frac < 0.05, mag_min=cfg.mag_min,
+                mag_max=cfg.mag_max,
+            ))
+            errs.append(float(np.linalg.norm(agg - hm)))
+        err = max(errs)
+    else:
+        attacked = jnp.asarray(rows)
+        if attack == "lie":
+            attacked = apply_gradient_attack(
+                "lie", attacked, mask, key=jax.random.PRNGKey(7)
+            )
+        attacked = _maybe_stale(attacked, mode)
+        agg = np.asarray(gars[rule].unchecked(attacked, f=F))
+        err = float(np.linalg.norm(agg - hm))
+    assert np.isfinite(err), f"{rule}/{attack}/{mode}: non-finite"
+    assert err <= tol, (
+        f"{rule}/{attack}/{mode}: err {err:.5f} > tol {tol:.5f} "
+        f"(spread {spread:.5f})"
+    )

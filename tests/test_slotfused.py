@@ -56,11 +56,16 @@ def x64():
         jax.config.update("jax_enable_x64", False)
 
 
-def _setup(module, shape, n=N, b=B, dtype=jnp.float32):
+def _setup(module, shape, n=N, b=B, dtype=jnp.float32, tokens=None):
     loss_fn = selectors.select_loss("nll")
     init_fn, grad_fn, _ = core.make_worker_fns(module, loss_fn)
     k = jax.random.PRNGKey(0)
-    x = jax.random.normal(k, (n, b) + shape, dtype)
+    if tokens is not None:
+        # Integer-token batches (the GPT/copytask family): ``shape`` is
+        # the (T,) sequence geometry, ``tokens`` the vocab size.
+        x = jax.random.randint(k, (n, b) + shape, 0, tokens)
+    else:
+        x = jax.random.normal(k, (n, b) + shape, dtype)
     y = jax.random.randint(k, (n, b), 0, 10)
     keys = jax.random.split(k, n)
     params, ms = init_fn(k, x[0])
@@ -109,9 +114,9 @@ def _assert_per_leaf(tree_t, tree_u, tol, floor_frac=0.02, what="grad"):
 
 
 def _check_family(module, shape, g_tol, ms_tol, n=N, b=B, loss_tol=1e-4,
-                  dtype=jnp.float32):
+                  dtype=jnp.float32, tokens=None):
     loss_fn, grad_fn, params, ms, x, y, keys = _setup(
-        module, shape, n, b, dtype
+        module, shape, n, b, dtype, tokens=tokens
     )
     slot_fn = slotfused.build_slot_grad_fn(module, loss_fn)
     assert slot_fn is not None
@@ -209,6 +214,86 @@ def test_twin_pipeline_pin_f32_slow(name, shape, g_tol, ms_tol, loss_tol):
         select_model(name, "cifar10"), shape, g_tol, ms_tol,
         loss_tol=loss_tol,
     )
+
+
+# --- transformer family (ViT + GPT, DESIGN.md §23) ------------------------
+#
+# CPU-affordable instances of the real classes (same twin path, same
+# auto-naming): the attention core is literally the SAME callable in the
+# flax module and the twin (slotlayers.attn_core), so these pins cover
+# the slot-resolved contractions around it — seq_dense einsums, the
+# per-slot LayerNorm affine, embedding gather transpose, positional
+# broadcast transpose, and the tied-head attend einsum.
+
+def _trans_modules(dtype=jnp.float32):
+    from garfield_tpu.models import transformer
+
+    vit = transformer.ViT(
+        num_classes=10, dtype=dtype, patch=4, dim=24, depth=2, heads=2,
+        mlp_dim=48,
+    )
+    gpt = transformer.GPT(
+        num_classes=10, dtype=dtype, vocab=16, dim=16, depth=2, heads=2,
+        mlp_dim=32,
+    )
+    gpt_tied = transformer.GPT(
+        num_classes=16, dtype=dtype, vocab=16, dim=16, depth=2, heads=2,
+        mlp_dim=32, tied=True,
+    )
+    return [("vit", vit, (8, 8, 3), None), ("gpt", gpt, (6,), 16),
+            ("gpt_tied", gpt_tied, (6,), 16)]
+
+
+@pytest.mark.parametrize("idx", range(3), ids=["vit", "gpt", "gpt_tied"])
+def test_transformer_twin_structural_pin_x64(x64, idx):
+    """Per-leaf f64 equality vs the unroll for the 8th family (measured
+    agreement ~1e-16 abs — attention reductions included): same two-tier
+    discipline as the conv zoo."""
+    _, module, shape, tokens = _trans_modules(jnp.float64)[idx]
+    _check_family(
+        module, shape, g_tol=1e-5, ms_tol=1e-7, loss_tol=1e-9,
+        dtype=jnp.float64, tokens=tokens,
+    )
+
+
+@pytest.mark.parametrize("idx", range(3), ids=["vit", "gpt", "gpt_tied"])
+def test_transformer_twin_pipeline_pin_f32(idx):
+    """f32 pipeline tier: no batch_stats (LayerNorm carries none) and no
+    BN degeneracy, so the transformer pins sit near the conv zoo's
+    tightest (cifarnet-level) tolerances."""
+    _, module, shape, tokens = _trans_modules()[idx]
+    _check_family(module, shape, g_tol=1e-4, ms_tol=1e-5,
+                  loss_tol=1e-5, tokens=tokens)
+
+
+def test_transformer_zoo_names_resolve_to_twins():
+    """The registered zoo entries (models/__init__.py) resolve through
+    the same registry the topology builders consult."""
+    loss_fn = selectors.select_loss("nll")
+    for name, dataset in (("vit_tiny", "cifar10"), ("gpt_tiny", "copytask")):
+        module = select_model(name, dataset)
+        assert slotfused.build_slot_grad_fn(module, loss_fn) is not None, name
+
+
+def test_trainer_ab_gpt(monkeypatch):
+    """Trainer-level fused-vs-unroll trajectory A/B on token batches:
+    3 aggregathor steps (median + lie) of the small GPT land within f32
+    tolerance — the transformer twin is live through the same
+    resolve_slot_grad_fn gate the conv zoo uses."""
+    from garfield_tpu.models import transformer
+
+    module = transformer.GPT(
+        num_classes=10, vocab=16, dim=16, depth=1, heads=2, mlp_dim=32
+    )
+    k = jax.random.PRNGKey(4)
+    n_w = 2 * jax.device_count()
+    x = jax.random.randint(k, (n_w, 4, 6), 0, 16)
+    y = jax.random.randint(jax.random.fold_in(k, 1), (n_w, 4), 0, 10)
+    finals = [
+        _trainer_final_params(module, x, y, disable, monkeypatch)
+        for disable in (False, True)
+    ]
+    np.testing.assert_allclose(finals[0], finals[1], rtol=1e-4, atol=1e-6)
 
 
 def test_registry_covers_the_dropout_free_zoo():
