@@ -97,7 +97,10 @@ def _spawn_env():
 # names in the JSONL stream; the fed_bench row speaks the ISSUE's
 # vocabulary (ingest/h2d/fold/selection).
 _PHASE_NAMES = {
-    "ingest": "ingest",          # one push_rows wave (decode-free path)
+    "hier_ingest": "ingest",     # pre-timed, one per dispatched wave —
+    #                              counts align 1:1 with h2d/fold (the
+    #                              v12 capture timed an OUTER push_rows
+    #                              span instead and undercounted)
     "hier_h2d": "h2d",           # staging one wave onto the device
     "hier_wave": "fold",         # wave dispatch (+ readback in sync mode)
     "hier_fold_wait": "fold_wait",  # double-buffer blocking readback
@@ -177,8 +180,13 @@ def _shard_run(args):
         while i < cohort.size:
             pool = pools[(i // wave_rows) % 2]
             take = min(wave_rows, cohort.size - i)
-            with tele_trace.span("ingest", round=r, rows=int(take)):
-                server.push_rows(spec.slice_rows(pool[:take], s))
+            # Ingest attribution rides the reducer's per-wave
+            # hier_ingest spans (trace.emit) — no outer span here, so
+            # counts align with hier_wave/hier_h2d instead of one span
+            # per push_rows call. stable=True: the pool slice is a
+            # C-contiguous f32 block untouched until the next wave's
+            # readback, so whole waves fold zero-copy.
+            server.push_rows(spec.slice_rows(pool[:take], s), stable=True)
             i += take
         agg = server.finish_round()
         frame = wire.encode(agg, plane=s)  # the shard broadcast payload
@@ -290,6 +298,99 @@ def bitwise_cell(args):
         "round_s": round((time.perf_counter() - t0) / (2 * rounds), 4),
         "peak_rss_bytes": _rss(),
     }
+
+
+# --- the ingest micro-mode (batch vs per-frame decode) -----------------------
+
+
+def ingest_micro_cell(args):
+    """Batch-vs-per-frame decode isolation (INGESTBENCH_r*): for every
+    frame width x wire scheme x batch size, encode ``batch`` frames of
+    ``d`` elems, then decode them (a) per frame through ``decode_into``
+    — the pre-ISSUE-20 ingest loop — and (b) in one
+    ``decode_batch_into`` call into the same slab. Both paths are
+    asserted bitwise-identical before any timing is committed, and
+    min-over-reps is recorded (the gar_bench timing discipline: the
+    floor is the signal on a noisy shared host). The ``--ingest_d``
+    sweep brackets the claim: at small frames the per-frame Python
+    header trip dominates and the vectorized screen wins; at the
+    scaling cells' d_shard the CRC+memcpy floor dominates BOTH paths
+    and batch is a wash — committed either way (DESIGN.md §24). A
+    final pair of f32 rows per width times the CRC thread pool
+    (``GARFIELD_INGEST_THREADS=2``) against inline CRC at the largest
+    batch — on this 1-core container that is the §24 negative result,
+    committed rather than hidden. Rows are schema-v15 ``fed_bench``
+    records (check="ingest_micro"): the decode micro has no GAR in the
+    loop, so ``gar`` is the literal "none" and the n/shards envelope
+    describes the batch itself."""
+    reps = args.ingest_reps
+    rng = np.random.default_rng(args.seed)
+    rows = []
+
+    def _time(fn):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def _row(d, scheme, batch, frames, *, threads=0):
+        out_seq = np.empty((batch, d), np.float32)
+        out_bat = np.empty((batch, d), np.float32)
+        os.environ["GARFIELD_INGEST_THREADS"] = str(threads)
+        try:
+            def per_frame():
+                for i, fr in enumerate(frames):
+                    wire.decode_into(fr, out_seq[i], expect_elems=d)
+
+            def batched():
+                res = wire.decode_batch_into(frames, out_bat,
+                                             expect_elems=d)
+                assert all(r == d for r in res), res
+
+            per_frame()
+            batched()
+            equal = bool(np.array_equal(out_seq, out_bat))
+            assert equal, f"batch decode diverged: {scheme} k={batch}"
+            per_s, bat_s = _time(per_frame), _time(batched)
+        finally:
+            os.environ.pop("GARFIELD_INGEST_THREADS", None)
+        return {
+            "check": "ingest_micro", "n": batch, "d": d, "shards": 1,
+            "gar": "none", "scheme": scheme, "batch": batch,
+            "threads": threads, "frame_bytes": len(frames[0]),
+            "per_frame_s": round(per_s, 9), "batch_s": round(bat_s, 9),
+            "speedup": round(per_s / bat_s, 3),
+            "bitwise_equal": equal, "reps": reps,
+            "peak_rss_bytes": _rss(),
+        }
+
+    for d in args.ingest_d:
+        for scheme in wire.WIRE_SCHEMES:
+            for batch in args.ingest_batches:
+                vecs = rng.normal(size=(batch, d)).astype(np.float32)
+                frames = [wire.encode(vecs[i], scheme, plane=1)
+                          for i in range(batch)]
+                row = _row(d, scheme, batch, frames)
+                rows.append(row)
+                print(f"ingest_micro d={d} {scheme} k={batch}: "
+                      f"per_frame={row['per_frame_s'] * 1e3:.3f}ms "
+                      f"batch={row['batch_s'] * 1e3:.3f}ms "
+                      f"speedup={row['speedup']}", flush=True)
+        # The thread-pool A/B at the largest f32 batch: same frames,
+        # pool on vs off — committed either way (negative result on
+        # 1 core).
+        batch = max(args.ingest_batches)
+        vecs = rng.normal(size=(batch, d)).astype(np.float32)
+        frames = [wire.encode(vecs[i], "f32", plane=1)
+                  for i in range(batch)]
+        for threads in (0, 2):
+            row = _row(d, "f32", batch, frames, threads=threads)
+            rows.append(row)
+            print(f"ingest_micro d={d} f32 k={batch} threads={threads}: "
+                  f"batch={row['batch_s'] * 1e3:.3f}ms", flush=True)
+    return rows
 
 
 # --- the client fleet (jax-free --client children) ---------------------------
@@ -604,6 +705,25 @@ def main(argv=None):
     p.add_argument("--fleet_target", type=float, default=0.0,
                    help="Fleet target rounds/s (0 = derive ~1.8x the "
                         "initial fleet's theoretical rate).")
+    # ingest micro-mode knobs (INGESTBENCH_r*)
+    p.add_argument("--ingest_micro", action="store_true",
+                   help="Run ONLY the batch-vs-per-frame decode micro "
+                        "(schema-v15 fed_bench rows, "
+                        "check=ingest_micro) — every wire scheme x "
+                        "--ingest_batches, plus the CRC thread-pool "
+                        "A/B at the largest f32 batch.")
+    p.add_argument("--ingest_d", nargs="*", type=int,
+                   default=[1024, 10 ** 4],
+                   help="Frame widths (elems) swept by --ingest_micro: "
+                        "the small-frame regime where the per-frame "
+                        "Python header trip dominates, and the scaling "
+                        "cells' d_shard at S=1 where CRC+memcpy do.")
+    p.add_argument("--ingest_batches", nargs="*", type=int,
+                   default=[8, 64, 256],
+                   help="Frame-batch sizes for --ingest_micro.")
+    p.add_argument("--ingest_reps", type=int, default=5,
+                   help="Timing reps per --ingest_micro cell (min is "
+                        "committed).")
     p.add_argument("--json", type=str, default=None,
                    help="Dump rows to this JSON file + the schema-v10 "
                         "JSONL twin (fed_bench records).")
@@ -635,6 +755,9 @@ def main(argv=None):
         return _client_main(args)
 
     rows = []
+    if args.ingest_micro:
+        args.skip_bitwise = args.skip_scaling = args.skip_fleet = True
+        rows.extend(ingest_micro_cell(args))
     if not args.skip_bitwise:
         row = bitwise_cell(args)
         rows.append(row)

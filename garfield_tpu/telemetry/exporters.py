@@ -155,7 +155,19 @@ SCHEMA = "garfield-telemetry"
 # attributable lift, not raw rate), ``accuracy`` and ``defense``).
 # ``gar_bench`` --selection rows additionally sweep the
 # attention-shaped d regimes (heads * d_head * seq) — no new fields.
-SCHEMA_VERSION = 14
+# v15 (round 22, batched wire ingest — DESIGN.md §24): the new
+# ``ingest_batch`` EVENT (one bulk ``push_frames`` call on a shard
+# server: the ``shard``, how many ``frames`` arrived, how many were
+# ``rejected`` with ban attribution, the accepted ``bytes``, whether
+# the vectorized ``batched`` decode path ran or the call fell back to
+# per-frame decode, the wall ``dur_s``, and the round as ``step``),
+# the ``garfield_ingest_batch_seconds`` Prometheus series beside the
+# wire codec counters, and the ``fed_bench`` check="ingest_micro" row
+# family (INGESTBENCH_r*: batch-vs-per-frame decode isolation — extra
+# numeric columns like ``per_frame_s``/``batch_s``/``batch`` and a
+# ``scheme`` string ride the kind's open extra-field policy; the
+# required check/n/d/shards/gar envelope still applies).
+SCHEMA_VERSION = 15
 
 KINDS = ("run", "step", "event", "summary", "bench", "gar_bench",
          "transfer_bench", "exchange_bench", "hier_bench", "span",
@@ -540,6 +552,43 @@ def validate_record(rec):
                         f"membership.{key} must be a non-negative int "
                         f"or null, got {val!r}"
                     )
+        elif rec.get("event") == "ingest_batch":
+            # v15: one bulk push_frames call (batched wire ingest —
+            # DESIGN.md §24): frames in, rejects attributed, bytes
+            # accepted, and whether the vectorized path actually ran.
+            for key in ("shard", "frames", "rejected", "bytes"):
+                val = rec.get(key)
+                if not isinstance(val, int) or isinstance(val, bool) \
+                        or val < 0:
+                    _fail(
+                        f"ingest_batch.{key} must be a non-negative "
+                        f"int, got {val!r}"
+                    )
+            if rec["rejected"] > rec["frames"]:
+                _fail(
+                    f"ingest_batch.rejected ({rec['rejected']}) exceeds "
+                    f"frames ({rec['frames']})"
+                )
+            if not isinstance(rec.get("batched"), bool):
+                _fail(
+                    f"ingest_batch.batched must be a bool, "
+                    f"got {rec.get('batched')!r}"
+                )
+            dur = rec.get("dur_s")
+            if not _is_num(dur) or dur < 0:
+                _fail(
+                    f"ingest_batch.dur_s must be a non-negative "
+                    f"number, got {dur!r}"
+                )
+            step = rec.get("step")
+            if step is not None and (
+                not isinstance(step, int) or isinstance(step, bool)
+                or step < 0
+            ):
+                _fail(
+                    f"ingest_batch.step must be a non-negative int "
+                    f"or null, got {step!r}"
+                )
     elif kind == "span":
         # v5: one timed phase of a round (telemetry/trace.py).
         if not isinstance(rec.get("phase"), str) or not rec["phase"]:
@@ -1262,6 +1311,21 @@ def prometheus_text(hub):
                "Publisher-side frames shed to sender-queue overflow "
                "(backpressure; the send-side twin of plane_drop).",
                [({}, float(w["send_queue_drops"]))])
+    ib = hub.ingest_batch_stats()
+    if ib is not None:
+        # v15: the bulk ingest plane (DESIGN.md §24) — host seconds in
+        # push_frames split by path, plus the frame/reject totals that
+        # say whether the vectorized decode is actually being hit.
+        metric("garfield_ingest_batch_seconds", "counter",
+               "Host seconds spent in bulk frame ingest (push_frames), "
+               "split by whether the vectorized batch decode ran.",
+               [({"path": "batched"}, ib["batched_s"]),
+                ({"path": "fallback"}, ib["fallback_s"])])
+        metric("garfield_ingest_batch_frames_total", "counter",
+               "Frames offered to bulk ingest, and the subset rejected "
+               "with sender attribution.",
+               [({"outcome": "offered"}, float(ib["frames"])),
+                ({"outcome": "rejected"}, float(ib["rejected"]))])
     stale = hub.staleness_stats()
     if stale is not None:
         # v4: bounded-staleness async plane (DESIGN.md §14) — a real

@@ -141,6 +141,14 @@ class MetricsHub:
         # breakdown (wire events' ``schemes`` sub-object) behind the
         # garfield_wire_bytes_total{scheme=} Prometheus counters.
         self._wire_schemes = {}  # scheme -> {"bytes_out": n, "bytes_in": n}
+        # Schema v15 (round 22, batched wire ingest — DESIGN.md §24):
+        # folded from ``ingest_batch`` events — bulk push_frames calls,
+        # frames/rejects/seconds split by whether the vectorized decode
+        # path ran (garfield_ingest_batch_seconds{batched=}).
+        self._ingest_batch = {
+            "calls": 0, "frames": 0, "rejected": 0,
+            "batched_s": 0.0, "fallback_s": 0.0,
+        }
         # Elastic-membership accounting (schema v6, DESIGN.md §15):
         # folded from the PS autoscaler's "autoscale" events — running
         # active-worker count (the garfield_active_workers gauge) and
@@ -266,6 +274,13 @@ class MetricsHub:
                     acc["bytes_in"] += int(d.get("bytes_in", 0) or 0)
             elif kind == "send_queue_drop":
                 self._wire["send_queue_drops"] += 1
+            elif kind == "ingest_batch":
+                ib = self._ingest_batch
+                ib["calls"] += 1
+                ib["frames"] += int(fields.get("frames", 0) or 0)
+                ib["rejected"] += int(fields.get("rejected", 0) or 0)
+                key = "batched_s" if fields.get("batched") else "fallback_s"
+                ib[key] += float(fields.get("dur_s", 0.0) or 0.0)
             elif kind == "autoscale":
                 a = self._autoscale
                 if fields.get("action") == "spawn":
@@ -701,6 +716,21 @@ class MetricsHub:
             return {s: dict(d) for s, d in sorted(
                 self._wire_schemes.items()
             )}
+
+    def ingest_batch_stats(self):
+        """Bulk-ingest digest (schema v15), or None when no
+        ``ingest_batch`` event was folded (per-frame-only runs)."""
+        with self._lock:
+            ib = self._ingest_batch
+            if not ib["calls"]:
+                return None
+            return {
+                "calls": int(ib["calls"]),
+                "frames": int(ib["frames"]),
+                "rejected": int(ib["rejected"]),
+                "batched_s": float(ib["batched_s"]),
+                "fallback_s": float(ib["fallback_s"]),
+            }
 
     def autoscale_stats(self):
         """spawns/retires/active_workers over the run, or None when no

@@ -42,8 +42,13 @@ it; producers may add more):
   worker:     model_wait, grad_compute, straggle
   LEARN node: grad_compute, quorum, update, gossip
   app loop:   dispatch (tag chunk=k), eval, checkpoint
-  hierarchy:  hier_wave, hier_finalize
-  federated:  ingest, fed_shard_fold, selection
+  hierarchy:  hier_ingest, hier_wave, hier_h2d, hier_fold_wait,
+              hier_finalize (hier_ingest is PRE-TIMED — one record per
+              dispatched wave via ``emit``, accumulated from that
+              wave's row copies/decodes, so per-wave counts align with
+              hier_wave/hier_h2d exactly)
+  federated:  fed_shard_fold, selection (ingest attribution rides the
+              hierarchy's hier_ingest spans)
   soak:       soak_round (tag scenario=steady|rolling_restart|
               partition|churn — one span per sustained round; the
               SOAKBENCH SLO percentiles come from its phase stats)
@@ -56,7 +61,8 @@ import time
 
 from . import hub as _hub
 
-__all__ = ["span", "enable", "disable", "enabled", "requested", "Span"]
+__all__ = ["span", "emit", "enable", "disable", "enabled", "requested",
+           "Span"]
 
 # One mutable cell instead of rebindable module globals: ``span`` reads
 # it on every call (the disabled fast path), and a cell read is as cheap
@@ -179,3 +185,23 @@ def span(phase, **tags):
     if not _STATE["enabled"]:
         return _NULL
     return Span(phase, tags)
+
+
+def emit(phase, t_wall, dur_s, **tags):
+    """Emit one PRE-TIMED span record (same shape as ``Span`` emits).
+
+    For producers whose phase work is scattered across many small slices
+    that only become one logical unit later — the hierarchy's per-wave
+    ingest accounting (ISSUE 20) accumulates each row copy's duration
+    and reports ONE ``hier_ingest`` span per dispatched wave, so span
+    counts align 1:1 with the wave's ``hier_wave``/``hier_h2d`` records
+    instead of undercounting attribution by whatever the ingest
+    granularity happened to be. Callers time their own slices (and
+    should skip the clock reads entirely when ``enabled()`` is False —
+    the zero-cost contract is theirs to keep on this path)."""
+    if not _STATE["enabled"]:
+        return
+    who = _STATE["who"]
+    if who is not None and "who" not in tags:
+        tags = dict(tags, who=who)
+    _hub.emit_span(phase, t_wall=t_wall, dur_s=dur_s, tid=_tid(), **tags)

@@ -480,8 +480,26 @@ class PeerExchange:
             sem.release()
 
     def collect_begin(self, step, q, *, timeout_ms=30_000, peers=None,
-                      transform=None, plane=0):
+                      transform=None, batch_transform=None, plane=0):
         """Register the waiters for ``step`` NOW; harvest with ``.wait()``.
+
+        ``batch_transform`` (mutually exclusive with ``transform``) is
+        the BULK decode hook (ISSUE 20): waiters latch raw frames, and
+        the harvest hands every latched frame to one
+        ``batch_transform(items)`` call — ``items`` a list of
+        ``(peer_index, payload)`` pairs in peer order, returning one
+        result per item (store an exception instance, e.g. a WireError,
+        to attribute a reject to its sender exactly like a raising
+        per-frame ``transform``). A multi-frame quorum then takes ONE
+        vectorized trip through ``wire.decode_batch_into`` (e.g.
+        ``StreamingAggregator.wire_batch_transform``) instead of a
+        Python codec trip per frame. The exchange stays codec-agnostic:
+        frames are opaque bytes here, the hook owns the decode. The
+        trade against ``transform`` is overlap: per-frame transforms run
+        eagerly in waiter threads as frames land, the batch hook runs at
+        harvest — profitable exactly when per-frame Python overhead
+        exceeds the lost overlap (the 10^6-client ingest regime;
+        INGESTBENCH quantifies the crossover).
 
         Symmetric all-to-all protocols (LEARN gossip) need this split: with
         plain publish-then-``collect``, the moment the last node's frame
@@ -506,6 +524,12 @@ class PeerExchange:
         """
         if step >= _CLOSE_STEP:
             raise ValueError(f"step {step} reserved for the close sentinel")
+        if transform is not None and batch_transform is not None:
+            raise ValueError(
+                "transform and batch_transform are mutually exclusive: "
+                "per-frame eager decode and harvest-time batch decode "
+                "are different overlap strategies — pick one"
+            )
         plane = self._check_plane(plane)
         peers = list(range(self.n)) if peers is None else list(peers)
         if q > len(peers):
@@ -537,6 +561,32 @@ class PeerExchange:
             for ev in peer_cancels.values():
                 ev.set()
 
+        def harvest(out):
+            # Batch decode at harvest time (``batch_transform`` above):
+            # ONE hook call over every latched frame, per-peer results
+            # back in place — an exception instance in the result list
+            # stays that peer's stored ban evidence, and a hook that
+            # dies wholesale attributes the same evidence to every
+            # frame it was handed (the caller sees it per peer either
+            # way, never a silent drop).
+            if batch_transform is None or not out:
+                return out
+            items = sorted(out.items())
+            with _trace.span("decode", step=int(step), plane=int(plane),
+                             frames=len(items),
+                             nbytes=sum(len(p) for _, p in items)):
+                try:
+                    res = list(batch_transform(items))
+                except Exception as exc:  # noqa: BLE001
+                    return {i: exc for i, _ in items}
+            if len(res) != len(items):
+                raise RuntimeError(
+                    f"batch_transform returned {len(res)} results for "
+                    f"{len(items)} frames — the per-frame attribution "
+                    "contract needs exactly one result per frame"
+                )
+            return {i: r for (i, _), r in zip(items, res)}
+
         def wait():
             # Every waiter releases exactly once (success, give-up, or
             # deadline); keep draining until the quorum is met or all
@@ -562,14 +612,14 @@ class PeerExchange:
                                 step, q, len(results),
                                 time.monotonic() - t0, plane=plane,
                             )
-                            return dict(results)
+                            return harvest(dict(results))
                     if len(results) >= q:
                         sp.set(arrived=len(results))
                         _emit_wait(
                             step, q, len(results), time.monotonic() - t0,
                             plane=plane,
                         )
-                        return dict(results)
+                        return harvest(dict(results))
                     sp.set(arrived=len(results), timed_out=True)
                     _emit_wait(
                         step, q, len(results), time.monotonic() - t0,
@@ -589,7 +639,7 @@ class PeerExchange:
         return wait
 
     def collect(self, step, q, *, timeout_ms=30_000, peers=None,
-                transform=None, plane=0):
+                transform=None, batch_transform=None, plane=0):
         """Payloads of the q fastest peers (self included) at ``step``.
 
         Returns a dict {peer_index: payload} with >= q entries, or raises
@@ -601,11 +651,12 @@ class PeerExchange:
         both planes share one exchange without cross-talk. For symmetric
         protocols use ``collect_begin`` (see its docstring for the
         publish-then-collect race it closes). ``transform`` is the eager
-        per-frame decode hook (see ``_wait_slot``).
+        per-frame decode hook (see ``_wait_slot``); ``batch_transform``
+        the harvest-time bulk decode hook (see ``collect_begin``).
         """
         return self.collect_begin(
             step, q, timeout_ms=timeout_ms, peers=peers, transform=transform,
-            plane=plane,
+            batch_transform=batch_transform, plane=plane,
         )()
 
     def read_latest_begin(self, idx, min_step, *, transform=None, plane=0):

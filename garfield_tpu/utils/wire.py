@@ -90,6 +90,7 @@ runs the lie attack over both widths.
 
 import os
 import struct
+import threading
 import zlib
 
 import numpy as np
@@ -102,12 +103,15 @@ __all__ = [
     "wire_dtype",
     "wire_topk",
     "wire_fused",
+    "wire_batch_decode",
+    "ingest_threads",
     "topk_k",
     "check_plane",
     "check_epoch",
     "encode",
     "decode",
     "decode_into",
+    "decode_batch_into",
     "frame_plane",
     "frame_scheme",
     "frame_elems",
@@ -214,6 +218,64 @@ def wire_fused():
     return os.environ.get(
         "GARFIELD_WIRE_FUSED_DECODE", "1"
     ).lower() not in ("", "0", "false")
+
+
+def wire_batch_decode():
+    """Whether bulk frame consumers take the batched decode path
+    (``GARFIELD_WIRE_BATCH_DECODE``, default on): ``push_frames`` /
+    multi-frame harvests route through ``decode_batch_into`` — one
+    vectorized header screen + run-grouped slab dequant — instead of a
+    per-frame ``decode_into`` loop. Purely a host-CPU knob: both paths
+    are bitwise-identical and raise the same per-frame ``WireError``s
+    (pinned in tests/test_wire.py), so turning it off is only for
+    isolating the batch path when debugging."""
+    return os.environ.get(
+        "GARFIELD_WIRE_BATCH_DECODE", "1"
+    ).lower() not in ("", "0", "false")
+
+
+def ingest_threads():
+    """Worker-thread count for the batch decoder's CRC pass
+    (``GARFIELD_INGEST_THREADS``, default 0 = inline). ``zlib.crc32``
+    releases the GIL on sizeable buffers, so on a multi-core host a
+    small pool can overlap the integrity scan of wave w+1 with the fold
+    of wave w; on the 1-core bench container it only adds dispatch
+    overhead (measured in DESIGN.md §24), hence off by default."""
+    v = os.environ.get("GARFIELD_INGEST_THREADS", "0").strip()
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(
+            f"GARFIELD_INGEST_THREADS must be a non-negative integer, "
+            f"got {v!r}"
+        )
+    if n < 0:
+        raise ValueError(
+            f"GARFIELD_INGEST_THREADS must be >= 0 (0 = inline), got {n}"
+        )
+    return n
+
+
+# Shared CRC pool for decode_batch_into: built lazily at first use and
+# reused across calls (a per-batch pool would pay thread spawn on every
+# wave, drowning the overlap it exists to buy). Guarded by a lock —
+# batch decodes run from exchange waiter threads concurrently.
+_CRC_POOL = {"n": 0, "exec": None}
+_CRC_POOL_LOCK = threading.Lock()
+
+
+def _crc_pool(n):
+    with _CRC_POOL_LOCK:
+        if _CRC_POOL["exec"] is None or _CRC_POOL["n"] != n:
+            from concurrent.futures import ThreadPoolExecutor
+
+            if _CRC_POOL["exec"] is not None:
+                _CRC_POOL["exec"].shutdown(wait=False)
+            _CRC_POOL["exec"] = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="wire-crc"
+            )
+            _CRC_POOL["n"] = n
+        return _CRC_POOL["exec"]
 
 
 def topk_k(elems, div):
@@ -749,6 +811,255 @@ def decode_into(buf, out, *, expect_plane=None, expect_elems=None,
         dst[...] = 0.0
         dst[pairs["i"].astype(np.int64)] = pairs["v"]
     return elems
+
+
+def decode_batch_into(bufs, out2d, *, expect_plane=None, expect_elems=None,
+                      max_elems=None, expect_epoch=None):
+    """Decode ``k`` typed frames into the rows of a preallocated 2-D
+    float32 slab; frame ``i`` lands in ``out2d[i, :elems_i]``. Returns a
+    ``k``-list of per-frame results: the element count written for an
+    accepted frame, or the ``WireError`` REJECTING it — never raises per
+    frame, so one forged frame bans its sender without poisoning its
+    batchmates (the exchange layer's stored-exception convention).
+
+    The batched half of the ingest plane (DESIGN.md §24). Per-frame
+    ``decode_into`` pays a full Python trip per client frame — header
+    unpack, CRC call, per-frame dequant — which FEDBENCH_r02 showed
+    dominating the million-client round. This runs the SAME validation
+    pipeline restructured into three batch passes:
+
+    1. **vectorized header screen**: the first 20 bytes of every frame,
+       packed into one (k, 20) uint8 view — magic/version/dtype-tag/
+       plane/epoch/element-count/structural-length checks as numpy
+       comparisons over the whole batch at once;
+    2. **per-frame CRC** on zero-copy payload slices (``zlib.crc32``
+       releases the GIL; ``GARFIELD_INGEST_THREADS`` optionally fans
+       this pass over a small shared pool — see ``ingest_threads``);
+    3. **run-grouped dequant**: maximal runs of consecutive accepted
+       frames sharing (scheme, elems[, block]) decode as ONE vectorized
+       op — an (m, elems) int8/int4 code slab times broadcast scales
+       instead of m Python calls — written straight into the contiguous
+       row range. f32/bf16 rows are single memcpy-bound ops per frame
+       already (no dequant to fuse) and topk scatters are inherently
+       per-frame, so those run per row inside the batch loop.
+
+    Every multiply is elementwise-identical to ``decode_into``'s, so
+    accepted rows are BITWISE-equal to the per-frame path (pinned in
+    tests/test_wire.py). Any frame the screen, CRC, or semantic pass
+    rejects is re-run through per-frame ``decode_into`` to produce its
+    error — the reject text, the validation order, and the
+    target-row-untouched guarantee are therefore identical to the
+    per-frame path BY CONSTRUCTION, not by parallel maintenance; the
+    recompute only ever costs on ban evidence. Allocation pins work
+    exactly as in ``decode_into``: with neither ``expect_elems`` nor
+    ``max_elems`` given, the slab's row width is the implicit bound, and
+    the screen rejects over-claiming headers before any payload-sized
+    work.
+    """
+    out2d = np.asarray(out2d)
+    if (out2d.dtype != np.float32 or out2d.ndim != 2
+            or not out2d.flags.c_contiguous or not out2d.flags.writeable):
+        raise TypeError(
+            "decode_batch_into target must be a writable C-contiguous "
+            f"2-D float32 array, got {out2d.dtype} ndim={out2d.ndim}"
+        )
+    k = len(bufs)
+    if k > out2d.shape[0]:
+        raise ValueError(
+            f"{k} frames but the target slab holds only "
+            f"{out2d.shape[0]} rows"
+        )
+    if k == 0:
+        return []
+    row_elems = out2d.shape[1]
+    pins = dict(expect_plane=expect_plane, expect_elems=expect_elems,
+                max_elems=max_elems, expect_epoch=expect_epoch)
+
+    # -- pass 1: vectorized header screen over a packed (k, 20) view --
+    lens = np.fromiter((len(b) for b in bufs), np.int64, count=k)
+    hdr = np.frombuffer(
+        b"".join(
+            bytes(b[:HEADER2_NBYTES]).ljust(HEADER2_NBYTES, b"\0")
+            for b in bufs
+        ),
+        np.uint8,
+    ).reshape(k, HEADER2_NBYTES)
+    ver = hdr[:, 2]
+    tag = hdr[:, 3] & 0x0F
+    plane = hdr[:, 3] >> 4
+    # Big-endian field reads via tiny contiguous copies (k*8 bytes).
+    # elems stays u64: a forged header can claim up to 2**64-1, and a
+    # signed cast could wrap a bomb into a small number that slips the
+    # bound screen.
+    elems_u = hdr[:, 4:12].copy().view(">u8").reshape(k)
+    epoch_u = hdr[:, 12:16].copy().view(">u4").reshape(k)
+    isv2 = ver == _VERSION_EPOCH
+    ok = lens >= HEADER_NBYTES
+    ok &= (hdr[:, 0] == _MAGIC[0]) & (hdr[:, 1] == _MAGIC[1])
+    ok &= (ver == _VERSION) | isv2
+    ok &= ~(isv2 & (lens < HEADER2_NBYTES))
+    ok &= tag <= _TAG_TOPK
+    if expect_plane is not None:
+        ok &= plane == check_plane(expect_plane, "expect_plane")
+    if expect_epoch is not None:
+        ok &= isv2 & (epoch_u == check_epoch(expect_epoch, "expect_epoch"))
+    if expect_elems is not None:
+        ok &= elems_u == int(expect_elems)
+    if max_elems is not None:
+        ok &= elems_u <= int(max_elems)
+    elif expect_elems is None:
+        ok &= elems_u <= row_elems  # the implicit allocation bound
+    ok &= elems_u <= row_elems  # decode_into's target-row fit check
+    # Structural length (same pre-CRC position as _checked_frame's):
+    # exact for the fixed-width schemes, the block prefix for quant,
+    # whole bounded pairs for topk. Rejected lanes may hold garbage
+    # element counts, so the arithmetic runs on a masked copy.
+    plen = lens - np.where(isv2, HEADER2_NBYTES, HEADER_NBYTES)
+    se = np.where(ok, elems_u, 0).astype(np.int64)
+    st = ((tag == _TAG_F32) & (plen == se * 4))
+    st |= (tag == _TAG_BF16) & (plen == se * 2)
+    st |= ((tag == _TAG_INT8) | (tag == _TAG_INT4)) & (plen >= 4)
+    st |= ((tag == _TAG_TOPK) & (plen % _PAIR.itemsize == 0)
+           & (plen // _PAIR.itemsize <= se))
+    ok &= st
+
+    # -- pass 2: per-frame CRC on zero-copy payload slices --
+    crc_hdr = np.where(
+        isv2,
+        hdr[:, 16:20].copy().view(">u4").reshape(k),
+        hdr[:, 12:16].copy().view(">u4").reshape(k),
+    )
+    off = np.where(isv2, HEADER2_NBYTES, HEADER_NBYTES)
+    payloads = [None] * k
+    idx_ok = np.flatnonzero(ok)
+    for i in idx_ok:
+        payloads[i] = memoryview(bufs[i])[int(off[i]):]
+
+    def _crc_ok(i):
+        seed = zlib.crc32(_EPOCH.pack(int(epoch_u[i]))) if isv2[i] else 0
+        return zlib.crc32(payloads[i], seed) == int(crc_hdr[i])
+
+    nthr = ingest_threads()
+    if nthr > 1 and idx_ok.size >= 2 * nthr:
+        passed = list(_crc_pool(nthr).map(_crc_ok, idx_ok))
+    else:
+        passed = [_crc_ok(i) for i in idx_ok]
+    for p, i in zip(passed, idx_ok):
+        if not p:
+            ok[i] = False
+
+    # Quant structural prescreen (integer math only): the block prefix
+    # and the exact payload length _checked_quant enforces, per frame,
+    # so run grouping below can key on a trusted block.
+    blocks = np.zeros(k, np.int64)
+    for i in np.flatnonzero(ok & ((tag == _TAG_INT8) | (tag == _TAG_INT4))):
+        e = int(elems_u[i])
+        b = int.from_bytes(bytes(payloads[i][:4]), "little")
+        nblocks = -(-e // b) if (b >= 1 and e) else 0
+        cn = e if tag[i] == _TAG_INT8 else (e + 1) // 2
+        if (b < 1 or b > max(e, 1)
+                or int(plen[i]) != 4 + nblocks * 4 + cn):
+            ok[i] = False
+        else:
+            blocks[i] = b
+
+    # -- pass 3: run-grouped semantic checks + slab dequant --
+    results = [None] * k
+    fails = list(np.flatnonzero(~ok))
+    i = 0
+    while i < k:
+        if not ok[i]:
+            i += 1
+            continue
+        t = int(tag[i])
+        e = int(elems_u[i])
+        blk = int(blocks[i])
+        j = i + 1
+        while (j < k and ok[j] and int(tag[j]) == t
+               and int(elems_u[j]) == e and int(blocks[j]) == blk):
+            j += 1
+        run = list(range(i, j))
+        m = len(run)
+        if t == _TAG_F32:
+            for r in run:
+                out2d[r, :e] = np.frombuffer(payloads[r], np.float32)
+                results[r] = e
+        elif t == _TAG_BF16:
+            for r in run:
+                np.left_shift(
+                    np.frombuffer(payloads[r], np.uint16), np.uint32(16),
+                    out=out2d[r, :e].view(np.uint32), dtype=np.uint32,
+                    casting="unsafe",
+                )
+                results[r] = e
+        elif t in (_TAG_INT8, _TAG_INT4):
+            nblocks = -(-e // blk) if e else 0
+            cn = e if t == _TAG_INT8 else (e + 1) // 2
+            scales2d = np.empty((m, nblocks), np.float32)
+            raw2d = np.empty((m, cn), np.uint8)
+            for q, r in enumerate(run):
+                scales2d[q] = np.frombuffer(
+                    payloads[r], "<f4", count=nblocks, offset=4
+                )
+                raw2d[q] = np.frombuffer(
+                    payloads[r], np.uint8, count=cn, offset=4 + nblocks * 4
+                )
+            bad = ~(np.isfinite(scales2d).all(axis=1)
+                    & (scales2d >= 0).all(axis=1))
+            if t == _TAG_INT8:
+                codes2d = raw2d.view(np.int8)
+                bad |= (codes2d == -128).any(axis=1)
+                cf = codes2d.astype(np.float32)
+            else:
+                nib2d = np.empty((m, cn * 2), np.uint8)
+                nib2d[:, 0::2] = raw2d & 0x0F
+                nib2d[:, 1::2] = raw2d >> 4
+                nib2d = nib2d[:, :e]
+                bad |= (nib2d == 0).any(axis=1)
+                cf = (nib2d.astype(np.int16) - 8).astype(np.float32)
+            # Broadcast the per-block scales to per-element and multiply
+            # the whole slab at once — elementwise-identical operands to
+            # _dequant/decode_into's per-block multiplies, so the rows
+            # are bitwise-equal (IEEE multiply is deterministic per
+            # element; the grouping changes nothing).
+            sc = np.repeat(scales2d, blk, axis=1)[:, :e] if e else \
+                np.empty((m, 0), np.float32)
+            np.multiply(cf, sc, out=cf)
+            if not bad.any():
+                out2d[i:j, :e] = cf
+                for r in run:
+                    results[r] = e
+            else:
+                for q, r in enumerate(run):
+                    if bad[q]:
+                        fails.append(r)
+                    else:
+                        out2d[r, :e] = cf[q]
+                        results[r] = e
+        else:  # _TAG_TOPK — scatter is inherently per-row
+            for r in run:
+                try:
+                    pairs = _checked_pairs(payloads[r], e)
+                except WireError:
+                    fails.append(r)
+                    continue
+                dst = out2d[r, :e]
+                dst[...] = 0.0
+                dst[pairs["i"].astype(np.int64)] = pairs["v"]
+                results[r] = e
+        i = j
+
+    # Every reject re-runs the per-frame path for its error: identical
+    # text, identical validation order, target row provably untouched —
+    # and if the screen ever under-accepts (it should be exact), the
+    # frame simply decodes here instead of raising, keeping the batch
+    # path semantics-preserving rather than semantics-approximating.
+    for r in fails:
+        try:
+            results[r] = decode_into(bufs[r], out2d[r], **pins)
+        except WireError as err:
+            results[r] = err
+    return results
 
 
 def frame_plane(buf):

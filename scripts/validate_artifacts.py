@@ -40,7 +40,14 @@ slot-fused-transformer additions (the ``trans_bench`` kind behind
 TRANSBENCH_r*'s rows: fused-vs-unrolled A/B latency cells with their
 ``dw_mode``/``dce_guard``/``per_slot_grad_s``/``speedup`` columns and
 the token-backdoor robustness cells with ``asr``/``asr_baseline``/
-``accuracy`` per defense; auto-globbed like every ``*_r*.jsonl``).
+``accuracy`` per defense; auto-globbed like every ``*_r*.jsonl``) — and
+the v15 batched-wire-ingest additions (the ``ingest_batch`` event —
+per-bulk-call shard/frames/rejected/bytes with ``rejected <= frames``
+and accepted-only byte accounting — plus the ``fed_bench`` kind's
+``check="ingest_micro"`` row family behind INGESTBENCH_r*'s
+batch-vs-per-frame decode A/B cells and FEDBENCH_r03's scaling rows
+with per-phase attribution on every row; both auto-globbed like every
+``*_r*.jsonl``).
 
   python scripts/validate_artifacts.py            # repo root auto-found
   python scripts/validate_artifacts.py /some/repo

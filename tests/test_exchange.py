@@ -512,3 +512,95 @@ def test_remove_peer_tears_down_all_watchers():
     finally:
         for p in peers:
             p.close()
+
+
+# ---------------------------------------------------------------------------
+# harvest-time batch transform (ISSUE 20)
+
+
+def test_batch_transform_harvests_quorum_in_one_call():
+    """batch_transform sees the whole quorum's latched raw frames as
+    sorted (peer, payload) items in ONE call at harvest time and must
+    return one result per item; results map back to peers."""
+    peers = _mesh(4)
+    calls = []
+
+    def batch(items):
+        calls.append([i for i, _ in items])
+        return [payload.decode() + "!" for _, payload in items]
+
+    try:
+        wait = peers[0].collect_begin(
+            0, q=3, peers=[1, 2, 3], timeout_ms=10_000,
+            batch_transform=batch,
+        )
+        for p in peers[1:]:
+            p.publish(0, f"p{p.my_index}".encode(), to=[0])
+        got = wait()
+    finally:
+        for p in peers:
+            p.close()
+    assert len(calls) == 1 and calls[0] == sorted(calls[0])
+    assert got == {i: f"p{i}!" for i in calls[0]}
+
+
+def test_batch_transform_exception_results_and_hook_failure():
+    """Step 0: an exception INSTANCE returned for one item is stored for
+    that peer only (the per-frame transform's stored-exception
+    convention, batched). Step 1: the whole hook raising stores the
+    exception for EVERY item. One mesh, two rounds — the close cost of
+    a localhost mesh dominates these tests."""
+    peers = _mesh(3)
+
+    def batch_instance(items):
+        return [
+            ValueError(f"bad {i}") if i == 2 else len(p)
+            for i, p in items
+        ]
+
+    def batch_raise(items):
+        raise RuntimeError("decoder exploded")
+
+    try:
+        wait = peers[0].collect_begin(
+            0, q=2, peers=[1, 2], timeout_ms=10_000,
+            batch_transform=batch_instance,
+        )
+        peers[1].publish(0, b"fine", to=[0])
+        peers[2].publish(0, b"forged", to=[0])
+        got = wait()
+        assert got[1] == 4
+        assert isinstance(got[2], ValueError) and "bad 2" in str(got[2])
+
+        wait = peers[0].collect_begin(
+            1, q=2, peers=[1, 2], timeout_ms=10_000,
+            batch_transform=batch_raise,
+        )
+        for p in peers[1:]:
+            p.publish(1, b"x", to=[0])
+        got = wait()
+        assert set(got) == {1, 2}
+        assert all(isinstance(v, RuntimeError) for v in got.values())
+    finally:
+        for p in peers:
+            p.close()
+
+
+def test_batch_transform_exclusivity_and_length_mismatch():
+    peers = _mesh(2)
+    try:
+        with pytest.raises(ValueError, match="batch_transform"):
+            peers[0].collect_begin(
+                0, q=1, peers=[1], transform=lambda i, p: p,
+                batch_transform=lambda items: [p for _, p in items],
+            )
+        wait = peers[0].collect_begin(
+            0, q=1, peers=[1], timeout_ms=10_000,
+            batch_transform=lambda items: [],
+        )
+        peers[1].publish(0, b"x", to=[0])
+        with pytest.raises(RuntimeError, match="batch_transform"):
+            wait()
+    finally:
+        for p in peers:
+            p.close()

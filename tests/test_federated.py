@@ -738,3 +738,130 @@ class TestFailoverDeterminism:
             json.dump(rec, fp)
         with pytest.raises(ValueError, match="torn"):
             self._engine(tmp_path, "a").resume(step=1)
+
+
+# ---------------------------------------------------------------------------
+# bulk wire ingest (ShardServer.push_frames — ISSUE 20)
+
+
+class TestShardBatchIngest:
+    """push_frames is semantics-preserving bulk ingest: batch ==
+    per-frame bitwise, arrival order never depends on the path taken
+    (any multi-row or unreadable frame demotes the WHOLE call to the
+    per-frame loop — bucket assignment IS arrival order), rejects are
+    indexed ban evidence, and the call emits one v15 ``ingest_batch``
+    event when a hub is installed."""
+
+    def _servers(self, d=32, shards=2, shard=1, n=8, **kw):
+        spec = fed.plan_shards(d, shards)
+        sv = fed.ShardServer(shard, spec, bucket_gar="average", **kw)
+        sv.begin_round(0, n, 0)
+        return spec, sv
+
+    def test_batch_bitwise_equals_per_frame(self):
+        d, n = 32, 8
+        rows, _ = honest_rows(n, d)
+        spec, sv_b = self._servers(d=d, n=n)
+        _, sv_s = self._servers(d=d, n=n)
+        frames = [wire.encode(spec.slice_rows(r, 1), plane=1)
+                  for r in rows]
+        res = sv_b.push_frames(frames)
+        assert res == list(range(n))
+        for fr in frames:
+            sv_s.push_frame(fr)
+        assert np.array_equal(sv_b.finish_round(), sv_s.finish_round())
+        assert sv_b.wire_bytes_in == sv_s.wire_bytes_in \
+            == sum(len(f) for f in frames)
+
+    def test_multi_row_frame_demotes_whole_call_preserving_order(self):
+        d, n = 32, 6
+        rows, _ = honest_rows(n, d)
+        spec, sv_m = self._servers(d=d, n=n)
+        _, sv_s = self._servers(d=d, n=n)
+        # frame 2 carries TWO rows: the batch prescreen must fall back
+        # for ALL frames, in list order, or bucket assignment would
+        # depend on which path ran.
+        frames = [
+            wire.encode(spec.slice_rows(rows[0], 1), plane=1),
+            wire.encode(spec.slice_rows(rows[1], 1), plane=1),
+            wire.encode(spec.slice_rows(rows[2:4], 1).ravel(), plane=1),
+            wire.encode(spec.slice_rows(rows[4], 1), plane=1),
+            wire.encode(spec.slice_rows(rows[5], 1), plane=1),
+        ]
+        res = sv_m.push_frames(frames)
+        assert res == [0, 1, 2, 4, 5]  # frame 2 ingests rows 2 AND 3
+        assert sv_m.arrived() == n
+        for fr in frames:
+            sv_s.push_frame(fr)
+        assert np.array_equal(sv_m.finish_round(), sv_s.finish_round())
+
+    def test_rejects_are_indexed_ban_evidence(self):
+        d, n = 32, 5
+        rows, _ = honest_rows(n + 1, d)
+        spec, sv = self._servers(d=d, n=n)
+        frames = [wire.encode(spec.slice_rows(r, 1), plane=1)
+                  for r in rows[:n]]
+        bad = bytearray(frames[1])
+        bad[-1] ^= 0xFF  # CRC break
+        frames[1] = bytes(bad)
+        # cross-shard stamp: header-level reject, still indexed
+        frames[3] = wire.encode(spec.slice_rows(rows[n], 0), plane=0)
+        res = sv.push_frames(frames)
+        assert isinstance(res[1], wire.WireError)
+        assert isinstance(res[3], wire.WireError)
+        assert [r for i, r in enumerate(res) if i not in (1, 3)] \
+            == [0, 1, 2]
+        assert sv.arrived() == 3
+
+    def test_ingest_batch_event_emitted_and_validates(self):
+        d, n = 32, 4
+        rows, _ = honest_rows(n, d)
+        spec, sv = self._servers(d=d, n=n)
+        frames = [wire.encode(spec.slice_rows(r, 1), plane=1)
+                  for r in rows]
+        bad = bytearray(frames[2])
+        bad[-1] ^= 0xFF
+        frames[2] = bytes(bad)
+        h = tele_hub.MetricsHub()
+        prev = tele_hub.install(h)
+        try:
+            sv.push_frames(frames)
+        finally:
+            tele_hub.uninstall()
+            if prev is not None:
+                tele_hub.install(prev)
+        evs = [r for r in h.records()
+               if r["kind"] == "event" and r.get("event") == "ingest_batch"]
+        assert len(evs) == 1
+        ev = evs[0]
+        exporters.validate_record(ev)
+        assert ev["shard"] == 1 and ev["frames"] == n
+        assert ev["rejected"] == 1 and ev["batched"] is True
+        assert ev["bytes"] == sum(
+            len(f) for i, f in enumerate(frames) if i != 2)
+        assert ev["step"] == 0
+        stats = h.ingest_batch_stats()
+        assert stats["calls"] == 1 and stats["rejected"] == 1
+        assert stats["batched_s"] > 0.0 and stats["fallback_s"] == 0.0
+
+    def test_wire_batch_transform_is_push_frames(self):
+        d, n = 32, 3
+        rows, _ = honest_rows(n, d)
+        spec, sv = self._servers(d=d, n=n)
+        items = [(5 + i, wire.encode(spec.slice_rows(r, 1), plane=1))
+                 for i, r in enumerate(rows)]
+        assert sv.wire_batch_transform(items) == [0, 1, 2]
+        assert sv.arrived() == n
+
+    def test_epoch_pin_applies_in_batch(self):
+        d, n = 32, 4
+        rows, _ = honest_rows(n, d)
+        spec, sv = self._servers(d=d, n=n, epoch=3)
+        frames = [wire.encode(spec.slice_rows(r, 1), plane=1, epoch=3)
+                  for r in rows]
+        frames[1] = wire.encode(
+            spec.slice_rows(rows[1], 1), plane=1, epoch=2)  # stale
+        res = sv.push_frames(frames)
+        assert isinstance(res[1], wire.WireError)
+        assert "epoch" in str(res[1])
+        assert [r for i, r in enumerate(res) if i != 1] == [0, 1, 2]

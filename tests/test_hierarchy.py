@@ -483,3 +483,160 @@ class TestFusedFrameIngest:
         for fr in frames:
             ref.push_frame(fr)
         assert np.array_equal(red.finalize(), ref.finalize())
+
+
+# ---------------------------------------------------------------------------
+# batched frame ingest + zero-copy stable dispatch (ISSUE 20)
+
+
+class TestBatchFrameIngest:
+    """push_frames is a bulk entry, not a new semantics: batch ingest ==
+    per-frame ingest == the batch hierarchy, bitwise; rejects surface as
+    indexed WireErrors (ban evidence) that consume no arrival slot; the
+    env kill-switch path is bitwise-identical."""
+
+    def _frames(self, g, scheme="f32", plane=0, epoch=None):
+        kw = {} if epoch is None else {"epoch": epoch}
+        return [wire.encode(row, scheme, plane=plane, **kw) for row in g]
+
+    @pytest.mark.parametrize("scheme", ["f32", "int8", "topk"])
+    def test_push_frames_bitwise_equals_per_frame_and_batch(self, scheme):
+        n, d, f = 32, 40, 1
+        g = honest_stack(n, d)
+        frames = self._frames(g, scheme)
+        rows = np.stack([wire.decode(fr, expect_elems=d) for fr in frames])
+        red_b = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=8, d=d)
+        assert red_b.push_frames(frames) == list(range(n))
+        red_s = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=8, d=d)
+        for fr in frames:
+            red_s.push_frame(fr)
+        want = np.asarray(hierarchy.aggregate(
+            rows, f, bucket_gar="krum", bucket_size=8))
+        assert np.array_equal(red_b.finalize(), red_s.finalize())
+        assert np.array_equal(red_b.finalize(), want)
+
+    def test_rejects_are_indexed_and_consume_no_slot(self):
+        n, d = 7, 16
+        g = honest_stack(8, d)
+        frames = self._frames(g)
+        bad = bytearray(frames[2])
+        bad[-1] ^= 0xFF
+        frames[2] = bytes(bad)
+        red = hierarchy.StreamingAggregator(
+            n, 0, bucket_gar="median", bucket_size=4, d=d)
+        res = red.push_frames(frames[:5])
+        assert isinstance(res[2], wire.WireError)
+        assert [r for i, r in enumerate(res) if i != 2] == [0, 1, 2, 3]
+        assert red.push_frames(frames[5:]) == [4, 5, 6]
+        keep = np.delete(np.arange(8), 2)
+        want = np.asarray(hierarchy.aggregate(
+            g[keep], 0, bucket_gar="median", bucket_size=4))
+        assert np.array_equal(red.finalize(), want)
+
+    def test_batch_env_off_falls_back_bitwise(self, monkeypatch):
+        n, d = 16, 24
+        g = honest_stack(n, d)
+        frames = self._frames(g, "int8")
+        outs = {}
+        for knob in ("1", "0"):
+            monkeypatch.setenv("GARFIELD_WIRE_BATCH_DECODE", knob)
+            red = hierarchy.StreamingAggregator(
+                n, 0, bucket_gar="median", bucket_size=4, d=d)
+            assert red.push_frames(frames) == list(range(n))
+            outs[knob] = red.finalize()
+        assert np.array_equal(outs["1"], outs["0"])
+
+    def test_capacity_overflow_raises_before_any_ingest(self):
+        d = 16
+        g = honest_stack(8, d)
+        red = hierarchy.StreamingAggregator(
+            7, 0, bucket_gar="median", bucket_size=4, d=d)
+        with pytest.raises(ValueError, match="8 frames"):
+            red.push_frames(self._frames(g))
+        assert red._arrived == 0
+
+    def test_epoch_pins_thread_through(self):
+        n, d = 8, 16
+        g = honest_stack(n, d)
+        frames = self._frames(g, plane=1, epoch=5)
+        frames[3] = wire.encode(g[3], plane=1, epoch=4)  # stale
+        red = hierarchy.StreamingAggregator(
+            n, 0, bucket_gar="median", bucket_size=4, d=d)
+        res = red.push_frames(frames, expect_plane=1, expect_epoch=5)
+        assert isinstance(res[3], wire.WireError)
+        assert "epoch" in str(res[3])
+        assert [r for i, r in enumerate(res) if i != 3] == list(range(7))
+
+
+class TestStableDispatch:
+    """push_many(stable=True): whole waves fold straight on the caller's
+    block (no staging memcpy) — bitwise-equal to the copy path, and
+    non-eligible inputs (non-contiguous, wrong dtype, partial fill)
+    silently take the copy path."""
+
+    def test_stable_bitwise_equals_copy(self):
+        n, d, f = 64, 32, 3
+        g = honest_stack(n, d)
+        red_c = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=8, wave_buckets=2)
+        red_c.push_many(g.copy())
+        red_s = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=8, wave_buckets=2)
+        red_s.push_many(g, stable=True)
+        assert np.array_equal(red_c.finalize(), red_s.finalize())
+
+    def test_stable_with_tail_and_partial_fill(self):
+        # 50 rows over 8-bucket waves: whole waves go zero-copy, the
+        # tail rides the copy path; a pre-filled buffer (odd split)
+        # forces the copy path until the fill drains.
+        n, d, f = 50, 24, 2
+        g = honest_stack(n, d)
+        red_c = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=8, wave_buckets=2)
+        red_c.push_many(g.copy())
+        red_s = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=8, wave_buckets=2)
+        red_s.push(g[0])                      # fill != 0: copy path
+        red_s.push_many(g[1:4], stable=True)  # still unaligned
+        red_s.push_many(g[4:], stable=True)   # drains to whole waves
+        assert np.array_equal(red_c.finalize(), red_s.finalize())
+
+    def test_non_contiguous_and_wrong_dtype_fall_back(self):
+        n, d, f = 32, 16, 1
+        wide = honest_stack(n, 2 * d)
+        view = wide[:, ::2]  # non-contiguous view
+        assert not view.flags["C_CONTIGUOUS"]
+        red_v = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=8, wave_buckets=2)
+        red_v.push_many(view, stable=True)
+        red_r = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=8, wave_buckets=2)
+        red_r.push_many(np.ascontiguousarray(view))
+        assert np.array_equal(red_v.finalize(), red_r.finalize())
+
+    def test_stable_with_audit_keeps_attribution(self):
+        from garfield_tpu.telemetry import hub as tele_hub
+
+        n, d, f = 32, 16, 1
+        g = honest_stack(n, d)
+        h = tele_hub.MetricsHub(num_ranks=n)
+        prev = tele_hub.install(h)
+        try:
+            red = hierarchy.StreamingAggregator(
+                n, f, bucket_gar="krum", bucket_size=8, wave_buckets=2,
+                telemetry=True)
+            red.push_many(g, stable=True)
+            out = red.finalize()
+        finally:
+            tele_hub.uninstall()
+            if prev is not None:
+                tele_hub.install(prev)
+        ref = hierarchy.StreamingAggregator(
+            n, f, bucket_gar="krum", bucket_size=8, wave_buckets=2)
+        ref.push_many(g)
+        assert np.array_equal(out, ref.finalize())
+        evs = [r for r in h.records()
+               if r["kind"] == "event" and r["event"] == "hier_exclusion"]
+        assert evs  # the audit trail survived the zero-copy path

@@ -118,3 +118,78 @@ def test_exchange_ingest_attributes_codec_rejects():
     assert isinstance(got[2], wire.WireError)
     assert sorted(v for k, v in got.items() if k != 2) == [0, 1]
     assert red.finalize().shape == (d,)
+
+
+@pytest.mark.slow
+def test_batch_harvest_matches_per_frame_collect():
+    """ISSUE 20: routing a multi-frame collect through
+    ``wire_batch_transform`` (one decode_batch_into harvest per quorum)
+    must produce the same aggregate as the per-frame ``wire_transform``
+    waiters — and a forged frame still surfaces as its sender's indexed
+    WireError while batchmates ingest. The batch harvest ingests in
+    sorted-peer order deterministically, so the reference reducer
+    replays that exact order per round."""
+    workers, rounds, d, bucket = 4, 8, 128, 8
+    n = workers * rounds
+    f = 1
+    hosts = [f"127.0.0.1:{p}" for p in _ports(workers + 1)]
+    peers = [PeerExchange(i, hosts) for i in range(workers + 1)]
+    collector, senders = peers[0], peers[1:]
+
+    rng = np.random.default_rng(42)
+    grads = rng.normal(size=(rounds, workers, d)).astype(np.float32)
+
+    red = hierarchy.StreamingAggregator(
+        n, f, bucket_gar="krum", top_gar="median", bucket_size=bucket,
+        wave_buckets=2, d=d)
+    ref = hierarchy.StreamingAggregator(
+        n, f, bucket_gar="krum", top_gar="median", bucket_size=bucket,
+        wave_buckets=2, d=d)
+    try:
+        for step in range(rounds):
+            wait = collector.collect_begin(
+                step, q=workers, peers=list(range(1, workers + 1)),
+                timeout_ms=30_000,
+                batch_transform=red.wire_batch_transform)
+            frames = {}
+            for w, sender in enumerate(senders):
+                frames[1 + w] = wire.encode(grads[step, w])
+                sender.publish(step, frames[1 + w], to=[0])
+            got = wait()
+            assert sorted(got) == list(range(1, workers + 1))
+            assert all(isinstance(v, int) for v in got.values())
+            # the batch harvest ingests in sorted peer order
+            for p in sorted(frames):
+                ref.push_frame(frames[p])
+        streamed = red.finalize()
+    finally:
+        for p in peers:
+            p.close()
+    assert np.array_equal(streamed, ref.finalize())
+
+
+@pytest.mark.slow
+def test_batch_harvest_attributes_forged_frame():
+    workers, d = 3, 64
+    hosts = [f"127.0.0.1:{p}" for p in _ports(workers + 1)]
+    peers = [PeerExchange(i, hosts) for i in range(workers + 1)]
+    collector, senders = peers[0], peers[1:]
+    red = hierarchy.StreamingAggregator(
+        workers - 1, 0, bucket_gar="median", bucket_size=2, d=d)
+    try:
+        wait = collector.collect_begin(
+            0, q=workers, peers=list(range(1, workers + 1)),
+            timeout_ms=30_000, batch_transform=red.wire_batch_transform)
+        rng = np.random.default_rng(5)
+        senders[0].publish(0, wire.encode(rng.normal(size=d)), to=[0])
+        frame = bytearray(wire.encode(rng.normal(size=d)))
+        frame[-1] ^= 0xFF  # payload flip: CRC must catch it
+        senders[1].publish(0, bytes(frame), to=[0])
+        senders[2].publish(0, wire.encode(rng.normal(size=d)), to=[0])
+        got = wait()
+    finally:
+        for p in peers:
+            p.close()
+    assert isinstance(got[2], wire.WireError)
+    assert sorted(v for k, v in got.items() if k != 2) == [0, 1]
+    assert red.finalize().shape == (d,)

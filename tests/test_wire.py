@@ -990,3 +990,201 @@ class TestEpochStamp:
         bad[2] = 0x09  # unknown version byte
         with pytest.raises(wire.WireError):
             wire.frame_epoch(bytes(bad))
+
+
+# --- batched decode (decode_batch_into — ISSUE 20) ---------------------------
+
+
+class TestDecodeBatchInto:
+    """The vectorized batch decoder is pinned BITWISE to the per-frame
+    ``decode_into`` loop: same outputs, same per-frame rejects with the
+    same error text, same pins — for every scheme and both header
+    versions. A forged frame in a batch bans its sender (an indexed
+    ``WireError`` in the result list) and never poisons batchmates or
+    touches its own target row."""
+
+    SCHEMES = ("f32", "bf16", "int8", "int4", "topk")
+
+    def _frames(self, scheme, k, d, *, plane=0, epoch=None, seed=0):
+        rng = np.random.default_rng(seed)
+        kw = {} if epoch is None else {"epoch": epoch}
+        return [
+            wire.encode(
+                rng.standard_normal(d).astype(np.float32), scheme,
+                plane=plane, **kw,
+            )
+            for _ in range(k)
+        ]
+
+    def _assert_matches_per_frame(self, frames, width, **pins):
+        """Batch-decode ``frames`` and check EVERY per-frame verdict —
+        accepted elems, written prefix, untouched tail/reject rows,
+        and reject error text — against the per-frame decode_into
+        reference. Returns the batch results."""
+        k = len(frames)
+        out = np.full((k, width), np.float32(-1.5))
+        res = wire.decode_batch_into(frames, out, **pins)
+        assert len(res) == k
+        for i, fr in enumerate(frames):
+            ref = np.full(width, np.float32(-1.5))
+            try:
+                want = wire.decode_into(fr, ref, **pins)
+            except wire.WireError as exc:
+                assert isinstance(res[i], wire.WireError), (i, res[i])
+                assert str(res[i]) == str(exc)
+            else:
+                assert res[i] == want, (i, res[i])
+            np.testing.assert_array_equal(out[i], ref)
+        return res
+
+    @pytest.mark.parametrize("epoch", [None, 7])
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    def test_bitwise_parity_every_scheme_and_header(self, scheme, epoch):
+        # 257 elems: a partial quant block + odd int4 nibble padding.
+        k, d = 9, 257
+        frames = self._frames(scheme, k, d, plane=1, epoch=epoch)
+        res = self._assert_matches_per_frame(
+            frames, d, expect_plane=1, expect_elems=d, expect_epoch=epoch,
+        )
+        assert res == [d] * k
+
+    def test_mixed_schemes_and_sizes_in_one_batch(self):
+        # Adjacent same-scheme runs of differing widths + scheme
+        # switches: the slab-dequant run grouping must break correctly.
+        rng = np.random.default_rng(3)
+        frames, widths = [], []
+        for rep in range(2):
+            for j, scheme in enumerate(self.SCHEMES):
+                d = 64 + 17 * j + 128 * rep
+                frames.append(wire.encode(
+                    rng.standard_normal(d).astype(np.float32), scheme,
+                ))
+                widths.append(d)
+        res = self._assert_matches_per_frame(frames, max(widths))
+        assert res == widths
+
+    @pytest.mark.parametrize("scheme", SCHEMES)
+    def test_corrupt_frame_never_poisons_batchmates(self, scheme):
+        k, d = 7, 129
+        frames = self._frames(scheme, k, d, seed=11)
+        bad = bytearray(frames[3])
+        bad[-1] ^= 0xFF  # payload flip: CRC must catch it
+        frames[3] = bytes(bad)
+        res = self._assert_matches_per_frame(frames, d, expect_elems=d)
+        assert isinstance(res[3], wire.WireError)
+        assert [r for i, r in enumerate(res) if i != 3] == [d] * (k - 1)
+
+    def test_fuzz_byte_flips_match_per_frame_verdicts(self):
+        """Flip one byte at a stride of positions in each scheme's
+        frame; the batch verdict for EVERY frame (the corrupted one and
+        its batchmates) must equal the per-frame path's — reject text
+        included. No assumption about WHICH rejection fires: the pin is
+        agreement, exactly the fuzz discipline of the per-frame fuzz
+        above."""
+        d = 65
+        rng = np.random.default_rng(17)
+        base = [
+            wire.encode(rng.standard_normal(d).astype(np.float32), s)
+            for s in self.SCHEMES
+        ]
+        for victim, fr in enumerate(base):
+            for pos in range(0, len(fr), max(1, len(fr) // 13)):
+                frames = list(base)
+                bad = bytearray(fr)
+                bad[pos] ^= 0x5A
+                frames[victim] = bytes(bad)
+                self._assert_matches_per_frame(frames, d)
+
+    def test_truncated_and_garbage_frames_reject_in_batch(self):
+        d = 48
+        good = self._frames("f32", 1, d, seed=2)[0]
+        frames = [good, good[:10], b"", b"not-a-frame", good[:-3], good]
+        res = self._assert_matches_per_frame(frames, d)
+        assert res[0] == d and res[5] == d
+        assert all(isinstance(r, wire.WireError) for r in res[1:5])
+
+    def test_pins_enforced_per_frame_in_batch(self):
+        d = 33
+        rng = np.random.default_rng(23)
+        v = rng.standard_normal(d).astype(np.float32)
+        v2 = rng.standard_normal(2 * d).astype(np.float32)
+        frames = [
+            wire.encode(v, "f32", plane=2, epoch=7),   # cross-plane
+            wire.encode(v, "f32", plane=1, epoch=7),   # accepted
+            wire.encode(v2, "f32", plane=1, epoch=7),  # wrong elems
+            wire.encode(v, "f32", plane=1, epoch=6),   # stale epoch
+            wire.encode(v, "f32", plane=1),            # epochless vs pin
+            wire.encode(v, "int4", plane=1, epoch=7),  # accepted
+        ]
+        res = self._assert_matches_per_frame(
+            frames, 2 * d, expect_plane=1, expect_elems=d, expect_epoch=7,
+        )
+        assert res[1] == d and res[5] == d
+        for i in (0, 2, 3, 4):
+            assert isinstance(res[i], wire.WireError), i
+
+    def test_max_elems_bounds_sparse_claims_pre_allocation(self):
+        """A CRC-valid topk frame claiming 2^40 dense elems must reject
+        on ``max_elems`` in the batch path exactly like decode_into —
+        BEFORE any payload-sized allocation (the allocation-bomb ban
+        surface, Baruch-style)."""
+        import struct
+        import zlib
+
+        d = 64
+        pairs = np.zeros(2, np.dtype([("i", "<u4"), ("v", "<f4")]))
+        pairs["i"] = [0, 1]
+        pairs["v"] = [5.0, -5.0]
+        payload = pairs.tobytes()
+        giant = struct.pack(
+            "!2sBBQI", b"GW", 1, 4, 2 ** 40, zlib.crc32(payload)
+        ) + payload
+        honest = self._frames("topk", 2, d, seed=5)
+        frames = [honest[0], giant, honest[1]]
+        res = self._assert_matches_per_frame(frames, d, max_elems=d)
+        assert res[0] == d and res[2] == d
+        assert isinstance(res[1], wire.WireError)
+
+    def test_crc_thread_pool_is_bitwise_identical(self, monkeypatch):
+        """GARFIELD_INGEST_THREADS only parallelizes the CRC pass —
+        verdicts and decoded bytes must not depend on it."""
+        k, d = 12, 257
+        frames = self._frames("int8", k, d, seed=7)
+        bad = bytearray(frames[5])
+        bad[-1] ^= 0xFF
+        frames[5] = bytes(bad)
+        outs = []
+        for threads in ("0", "2"):
+            monkeypatch.setenv("GARFIELD_INGEST_THREADS", threads)
+            out = np.zeros((k, d), np.float32)
+            res = wire.decode_batch_into(frames, out, expect_elems=d)
+            outs.append((out, res))
+        (out0, res0), (out1, res1) = outs
+        np.testing.assert_array_equal(out0, out1)
+        assert [str(r) for r in res0] == [str(r) for r in res1]
+        assert isinstance(res0[5], wire.WireError)
+
+    def test_env_knobs_parse(self, monkeypatch):
+        monkeypatch.delenv("GARFIELD_WIRE_BATCH_DECODE", raising=False)
+        assert wire.wire_batch_decode() is True  # default on
+        monkeypatch.setenv("GARFIELD_WIRE_BATCH_DECODE", "0")
+        assert wire.wire_batch_decode() is False
+        monkeypatch.setenv("GARFIELD_WIRE_BATCH_DECODE", "false")
+        assert wire.wire_batch_decode() is False
+        monkeypatch.delenv("GARFIELD_INGEST_THREADS", raising=False)
+        assert wire.ingest_threads() == 0  # default inline
+        monkeypatch.setenv("GARFIELD_INGEST_THREADS", "3")
+        assert wire.ingest_threads() == 3
+        monkeypatch.setenv("GARFIELD_INGEST_THREADS", "bogus")
+        with pytest.raises(ValueError, match="GARFIELD_INGEST_THREADS"):
+            wire.ingest_threads()
+
+    def test_rejects_unusable_slabs_loudly(self):
+        frames = self._frames("f32", 2, 16)
+        with pytest.raises((TypeError, ValueError)):
+            wire.decode_batch_into(frames, np.zeros((2, 16), np.float64))
+        with pytest.raises((TypeError, ValueError)):
+            wire.decode_batch_into(frames, np.zeros(32, np.float32))
+        wide = np.zeros((2, 32), np.float32)
+        with pytest.raises((TypeError, ValueError)):
+            wire.decode_batch_into(frames, wide[:, ::2])  # non-contiguous
